@@ -1,0 +1,44 @@
+"""evox_tpu — a TPU-native evolutionary-computation framework.
+
+Same capability surface as EvoX (ask–evaluate–tell algorithms, benchmark
+problems, neuroevolution, distributed workflows, monitors and metrics),
+re-architected for TPU: one jitted step over a ``jax.sharding.Mesh``,
+population sharded across chips, collectives over ICI, Pallas kernels for
+hot operators.
+"""
+
+__version__ = "0.1.0"
+
+from .core import (
+    Algorithm,
+    Problem,
+    Monitor,
+    PyTreeNode,
+    field,
+    static_field,
+    pytree_dataclass,
+    create_mesh,
+    POP_AXIS,
+)
+from . import algorithms, core, monitors, operators, problems, utils, workflows
+from .workflows import StdWorkflow
+
+__all__ = [
+    "Algorithm",
+    "Problem",
+    "Monitor",
+    "PyTreeNode",
+    "field",
+    "static_field",
+    "pytree_dataclass",
+    "create_mesh",
+    "POP_AXIS",
+    "StdWorkflow",
+    "algorithms",
+    "core",
+    "monitors",
+    "operators",
+    "problems",
+    "utils",
+    "workflows",
+]

@@ -1,0 +1,4 @@
+from . import so
+from .so.pso import PSO, CSO
+
+__all__ = ["so", "PSO", "CSO"]

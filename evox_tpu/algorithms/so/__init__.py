@@ -1,0 +1,4 @@
+from .pso import *  # noqa: F401,F403
+from . import pso
+
+__all__ = ["pso"]

@@ -1,0 +1,4 @@
+from .pso import PSO, PSOState
+from .cso import CSO, CSOState
+
+__all__ = ["PSO", "PSOState", "CSO", "CSOState"]

@@ -1,0 +1,44 @@
+from .struct import PyTreeNode, field, static_field, pytree_dataclass, replace
+from .algorithm import Algorithm
+from .problem import Problem
+from .monitor import Monitor, HOOK_NAMES
+from .distributed import (
+    POP_AXIS,
+    create_mesh,
+    pop_sharding,
+    replicated_sharding,
+    shard_pop,
+    replicate,
+    all_gather,
+    tree_all_gather,
+    init_distributed,
+    process_id,
+    process_count,
+    is_dist_initialized,
+)
+from . import state_io
+
+__all__ = [
+    "PyTreeNode",
+    "field",
+    "static_field",
+    "pytree_dataclass",
+    "replace",
+    "Algorithm",
+    "Problem",
+    "Monitor",
+    "HOOK_NAMES",
+    "POP_AXIS",
+    "create_mesh",
+    "pop_sharding",
+    "replicated_sharding",
+    "shard_pop",
+    "replicate",
+    "all_gather",
+    "tree_all_gather",
+    "init_distributed",
+    "process_id",
+    "process_count",
+    "is_dist_initialized",
+    "state_io",
+]

@@ -1,0 +1,67 @@
+"""Abstract Algorithm: the ask–evaluate–tell contract.
+
+Mirrors the capability of the reference's ``Algorithm`` (reference:
+src/evox/core/algorithm.py:10-96) with a purely functional, TPU-idiomatic
+signature: the algorithm object holds only *static* hyperparameters; all
+mutable data (population, strategy parameters, PRNG key) lives in a typed
+pytree state returned by ``init`` and threaded through ``ask``/``tell``.
+
+Optional ``init_ask``/``init_tell`` support algorithms whose first
+generation differs from steady state (e.g. GA-style algorithms that evaluate
+a full parent population once before producing offspring) — same duck-typed
+detection idea as reference algorithm.py:52-96, implemented via method
+override detection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+
+AlgorithmState = Any
+
+
+class Algorithm:
+    """Base class for every optimization algorithm.
+
+    Contract::
+
+        state = algo.init(key)                 # build initial state
+        pop, state = algo.ask(state)           # propose candidates
+        state = algo.tell(state, fitness)      # ingest fitness of `pop`
+
+    ``ask`` must return a ``(pop_size, ...)`` candidate array (or pytree with
+    leading pop axis). ``tell`` receives fitness with shape ``(pop_size,)``
+    for single-objective or ``(pop_size, n_objectives)`` for multi-objective.
+
+    First-generation overrides: implement ``init_ask``/``init_tell`` when the
+    initial evaluation differs (different pop size or bookkeeping). Workflows
+    dispatch them on generation 0 when present.
+    """
+
+    def init(self, key: jax.Array) -> AlgorithmState:
+        raise NotImplementedError
+
+    def ask(self, state: AlgorithmState) -> Tuple[Any, AlgorithmState]:
+        raise NotImplementedError
+
+    def tell(self, state: AlgorithmState, fitness: jax.Array) -> AlgorithmState:
+        raise NotImplementedError
+
+    # -- optional first-generation hooks ------------------------------------
+    def init_ask(self, state: AlgorithmState) -> Tuple[Any, AlgorithmState]:
+        """Candidates for the very first evaluation. Default: ``ask``."""
+        return self.ask(state)
+
+    def init_tell(self, state: AlgorithmState, fitness: jax.Array) -> AlgorithmState:
+        """Ingest the very first fitness batch. Default: ``tell``."""
+        return self.tell(state, fitness)
+
+    @property
+    def has_init_ask(self) -> bool:
+        return type(self).init_ask is not Algorithm.init_ask
+
+    @property
+    def has_init_tell(self) -> bool:
+        return type(self).init_tell is not Algorithm.init_tell

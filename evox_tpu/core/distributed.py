@@ -1,0 +1,127 @@
+"""Mesh-native distributed primitives.
+
+Replaces the reference's pmap + Ray stack (reference: src/evox/core/
+distributed.py, src/evox/workflows/distributed.py) with the modern JAX
+sharding model: one global ``jax.sharding.Mesh`` whose default axis is
+``"pop"``; population arrays are sharded along ``"pop"``; algorithm state is
+replicated; collectives (all_gather / psum over fitness) ride ICI within a
+TPU slice and DCN across slices, inserted either automatically by GSPMD from
+sharding constraints or explicitly inside ``shard_map`` islands.
+
+Multi-host: call :func:`init_distributed` (a thin wrapper over
+``jax.distributed.initialize``) on every host, then build the mesh over
+``jax.devices()`` — the same single-program step then runs SPMD across the
+whole pod, which is the TPU-native equivalent of the reference's
+``jax.distributed`` + NCCL path and entirely replaces its Ray RPC path for
+jittable problems.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+POP_AXIS = "pop"
+
+__all__ = [
+    "POP_AXIS",
+    "create_mesh",
+    "pop_sharding",
+    "replicated_sharding",
+    "shard_pop",
+    "replicate",
+    "all_gather",
+    "tree_all_gather",
+    "init_distributed",
+    "process_id",
+    "process_count",
+    "is_dist_initialized",
+]
+
+
+def create_mesh(
+    axis_names: Sequence[str] = (POP_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a device mesh. Default: 1-D mesh named ``"pop"`` over all devices."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    return Mesh(devices.reshape(shape), axis_names)
+
+
+def pop_sharding(mesh: Mesh, axis_name: str = POP_AXIS) -> NamedSharding:
+    """Sharding that splits the leading (population) axis across the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding over the mesh."""
+    return NamedSharding(mesh, P())
+
+
+def _constrain(tree: Any, sharding: NamedSharding) -> Any:
+    return jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x, sharding), tree)
+
+
+def shard_pop(tree: Any, mesh: Optional[Mesh], axis_name: str = POP_AXIS) -> Any:
+    """Constrain every leaf's leading axis to be sharded over ``axis_name``.
+
+    No-op when ``mesh`` is None (single-device path compiles identically).
+    """
+    if mesh is None:
+        return tree
+    return _constrain(tree, pop_sharding(mesh, axis_name))
+
+
+def replicate(tree: Any, mesh: Optional[Mesh]) -> Any:
+    """Constrain every leaf to be replicated over the mesh (no-op sans mesh)."""
+    if mesh is None:
+        return tree
+    return _constrain(tree, replicated_sharding(mesh))
+
+
+def all_gather(x: jax.Array, axis_name: str = POP_AXIS, tiled: bool = True) -> jax.Array:
+    """``lax.all_gather`` for use *inside* shard_map islands."""
+    return jax.lax.all_gather(x, axis_name, axis=0, tiled=tiled)
+
+
+def tree_all_gather(tree: Any, axis_name: str = POP_AXIS, tiled: bool = True) -> Any:
+    return jax.tree.map(lambda x: all_gather(x, axis_name, tiled), tree)
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs: Any,
+) -> None:
+    """Initialize multi-host JAX (call once per host before building meshes).
+
+    On TPU pods the arguments are auto-detected from the environment, so a
+    bare ``init_distributed()`` suffices.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def process_id() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_dist_initialized() -> bool:
+    return jax.process_count() > 1

@@ -1,0 +1,89 @@
+"""Monitor hook specification (reference: src/evox/core/monitor.py:11-47).
+
+Same 8-hook surface as the reference, redesigned functionally so monitor
+state is an on-device pytree threaded through the jitted workflow step —
+no host round-trip needed for elite tracking or Pareto archives. Monitors
+that want unbounded host-side history additionally use ``jax.experimental
+.io_callback`` internally (see monitors/eval_monitor.py).
+
+Each hook is pure: it receives the monitor state plus step data and returns
+an updated monitor state. A monitor declares which hooks it implements via
+``hooks()`` so the workflow only wires what is needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+
+MonitorState = Any
+
+HOOK_NAMES = (
+    "pre_step",
+    "pre_ask",
+    "post_ask",
+    "pre_eval",
+    "post_eval",
+    "pre_tell",
+    "post_tell",
+    "post_step",
+)
+
+
+class Monitor:
+    """Base monitor. Subclasses override ``init``, ``hooks`` and hook methods.
+
+    Hook signatures (all return the new monitor state):
+
+    - ``pre_step(mstate)``
+    - ``pre_ask(mstate)``
+    - ``post_ask(mstate, cand)``
+    - ``pre_eval(mstate, cand)``
+    - ``post_eval(mstate, cand, fitness)`` — fitness already in the
+      *user's* direction convention (workflows un-flip ``opt_direction``
+      before calling, so maximization problems see positive-is-better).
+    - ``pre_tell(mstate, transformed_fitness)``
+    - ``post_tell(mstate)``
+    - ``post_step(mstate, workflow_state)``
+    """
+
+    def init(self, key: Optional[jax.Array] = None) -> MonitorState:
+        return None
+
+    def hooks(self) -> Sequence[str]:
+        """Names of the hooks this monitor implements."""
+        raise NotImplementedError
+
+    def set_opt_direction(self, opt_direction: jax.Array) -> None:
+        """Called once by the workflow with the ±1 direction vector."""
+        self.opt_direction = opt_direction
+
+    # -- hooks (default: identity) ------------------------------------------
+    def pre_step(self, mstate: MonitorState) -> MonitorState:
+        return mstate
+
+    def pre_ask(self, mstate: MonitorState) -> MonitorState:
+        return mstate
+
+    def post_ask(self, mstate: MonitorState, cand: Any) -> MonitorState:
+        return mstate
+
+    def pre_eval(self, mstate: MonitorState, cand: Any) -> MonitorState:
+        return mstate
+
+    def post_eval(self, mstate: MonitorState, cand: Any, fitness: jax.Array) -> MonitorState:
+        return mstate
+
+    def pre_tell(self, mstate: MonitorState, fitness: jax.Array) -> MonitorState:
+        return mstate
+
+    def post_tell(self, mstate: MonitorState) -> MonitorState:
+        return mstate
+
+    def post_step(self, mstate: MonitorState, wf_state: Any) -> MonitorState:
+        return mstate
+
+    def flush(self) -> None:
+        """Block until any async host callbacks have landed."""
+        jax.effects_barrier()

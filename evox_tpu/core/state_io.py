@@ -1,0 +1,54 @@
+"""Checkpoint save / load (reference: src/evox/core/state.py:264-301).
+
+Because every evox_tpu state is a plain pytree, checkpointing is direct
+orbax ``StandardCheckpointer`` save/restore (sharding-aware: restore can
+target a ``NamedSharding`` layout for a different mesh than the one that
+saved), with a pickle fallback for quick local snapshots.
+"""
+
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+
+def save(state: Any, path: str, backend: str = "orbax") -> None:
+    """Save a state pytree to ``path``."""
+    path = Path(path).resolve()
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckpt:
+            ckpt.save(path, state)
+    elif backend == "pickle":
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "wb") as f:
+            pickle.dump(jax.device_get(state), f)
+    else:
+        raise ValueError(f"unknown checkpoint backend: {backend!r}")
+
+
+def load(path: str, target: Optional[Any] = None, backend: str = "orbax") -> Any:
+    """Load a state pytree from ``path``.
+
+    ``target``: an abstract or concrete pytree of the same structure (required
+    for orbax; leaves may carry ``sharding`` to restore directly into a mesh
+    layout different from the saving run).
+    """
+    path = Path(path).resolve()
+    if backend == "orbax":
+        import orbax.checkpoint as ocp
+
+        if target is None:
+            raise ValueError("orbax restore requires a `target` pytree template")
+        template = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
+        with ocp.StandardCheckpointer() as ckpt:
+            return ckpt.restore(path, template)
+    elif backend == "pickle":
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    else:
+        raise ValueError(f"unknown checkpoint backend: {backend!r}")

@@ -1,0 +1,94 @@
+"""Pytree dataclasses — the foundation of every state object in evox_tpu.
+
+Design note (TPU-first): unlike the reference's hierarchical ``State`` dict
+tree with node-ids and ``use_state`` re-scoping (reference:
+src/evox/core/state.py, src/evox/core/module.py), evox_tpu states are plain
+typed, frozen dataclasses registered as JAX pytrees. Composition is by
+*fields* (a workflow state holds the algorithm state as a field), stacking is
+by ``jax.vmap`` over ``init``, and sharding is by ``jax.NamedSharding`` over
+leaves. This keeps every state a first-class pytree that `jit`, `vmap`,
+`shard_map`, `pjit` and orbax all understand natively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, TypeVar
+
+import jax
+
+_T = TypeVar("_T")
+
+__all__ = [
+    "field",
+    "static_field",
+    "pytree_dataclass",
+    "PyTreeNode",
+    "replace",
+]
+
+
+def field(*, static: bool = False, **kwargs: Any) -> dataclasses.Field:
+    """A dataclass field; ``static=True`` marks it as pytree metadata
+    (hashable aux data, not traced)."""
+    metadata = dict(kwargs.pop("metadata", {}) or {})
+    metadata["static"] = static
+    return dataclasses.field(metadata=metadata, **kwargs)
+
+
+def static_field(**kwargs: Any) -> dataclasses.Field:
+    """Shorthand for ``field(static=True)``."""
+    return field(static=True, **kwargs)
+
+
+def _replace(self: _T, **changes: Any) -> _T:
+    """Return a copy of this pytree dataclass with the given fields replaced."""
+    return dataclasses.replace(self, **changes)
+
+
+def pytree_dataclass(cls: type[_T]) -> type[_T]:
+    """Turn ``cls`` into a frozen dataclass registered as a JAX pytree.
+
+    Fields declared with ``static_field()`` become aux (metadata) fields; all
+    other fields are pytree children. Adds a ``.replace(**changes)`` method.
+    """
+    cls = dataclasses.dataclass(frozen=True)(cls)
+    data_fields = []
+    meta_fields = []
+    for f in dataclasses.fields(cls):
+        if f.metadata.get("static", False):
+            meta_fields.append(f.name)
+        else:
+            data_fields.append(f.name)
+    jax.tree_util.register_dataclass(cls, data_fields, meta_fields)
+    cls.replace = _replace
+    return cls
+
+
+class PyTreeNode:
+    """Base class: subclasses are automatically pytree dataclasses.
+
+    Example::
+
+        class PSOState(PyTreeNode):
+            population: jax.Array
+            velocity: jax.Array
+            key: jax.Array
+    """
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        pytree_dataclass(cls)
+
+    # These stubs are overwritten by pytree_dataclass; they exist so type
+    # checkers know every PyTreeNode has them.
+    def replace(self: _T, **changes: Any) -> _T:  # pragma: no cover
+        raise NotImplementedError
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+def replace(obj: _T, **changes: Any) -> _T:
+    """Functional ``dataclasses.replace`` for any pytree dataclass."""
+    return dataclasses.replace(obj, **changes)

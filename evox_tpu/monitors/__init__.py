@@ -1,0 +1,3 @@
+from .eval_monitor import EvalMonitor, EvalMonitorState
+
+__all__ = ["EvalMonitor", "EvalMonitorState"]

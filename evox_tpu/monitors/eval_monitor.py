@@ -1,0 +1,149 @@
+"""EvalMonitor — elite / Pareto-front tracking (reference:
+src/evox/monitors/eval_monitor.py).
+
+TPU-first redesign: instead of shipping every batch to the host through
+``io_callback`` and keeping Python-side state (reference eval_monitor.py:
+69-96), the elite top-k buffer and the fixed-capacity Pareto archive are
+device arrays inside the monitor's pytree state, updated with pure jittable
+math — zero host sync in the hot loop. Unbounded full history (opt-in) still
+streams host-side via ``io_callback``, pinned to one device like the
+reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from ..core.monitor import Monitor
+from ..core.struct import PyTreeNode
+from ..operators.selection.non_dominate import non_dominate
+
+
+class EvalMonitorState(PyTreeNode):
+    topk_fitness: Optional[jax.Array]  # (k,) or (cap, m) raw user-direction
+    topk_solution: Optional[Any]
+    pf_count: Optional[jax.Array]
+
+
+class EvalMonitor(Monitor):
+    """Tracks the best-so-far individuals seen at evaluation time.
+
+    Single-objective: a ``topk`` elite buffer. Multi-objective: a running
+    Pareto archive of capacity ``pf_capacity`` (set ``multi_obj=True``).
+    ``full_fit_history`` / ``full_sol_history`` stream every generation to
+    host memory (outside jit) for offline analysis / plotting.
+    """
+
+    def __init__(
+        self,
+        topk: int = 1,
+        multi_obj: bool = False,
+        pf_capacity: int = 1024,
+        full_fit_history: bool = False,
+        full_sol_history: bool = False,
+    ):
+        self.topk = topk
+        self.multi_obj = multi_obj
+        self.pf_capacity = pf_capacity
+        self.full_fit_history = full_fit_history
+        self.full_sol_history = full_sol_history
+        self.fitness_history: list = []
+        self.solution_history: list = []
+        self.opt_direction = jnp.ones((1,), dtype=jnp.float32)
+
+    def hooks(self):
+        return ("post_eval",)
+
+    def init(self, key: Optional[jax.Array] = None) -> EvalMonitorState:
+        # lazy: buffers materialize on the first post_eval (shapes unknown here);
+        # the workflow's first-generation retrace absorbs the structure change.
+        return EvalMonitorState(topk_fitness=None, topk_solution=None, pf_count=None)
+
+    # ------------------------------------------------------------------ hook
+    def post_eval(self, mstate: EvalMonitorState, cand: Any, fitness: jax.Array) -> EvalMonitorState:
+        if self.full_fit_history or self.full_sol_history:
+            self._record_history(cand, fitness)
+        if fitness.ndim == 1 and not self.multi_obj:
+            return self._update_so(mstate, cand, fitness)
+        return self._update_mo(mstate, cand, fitness)
+
+    def _record_history(self, cand: Any, fitness: jax.Array) -> None:
+        def append(fit, sol):
+            if self.full_fit_history:
+                self.fitness_history.append(fit)
+            if self.full_sol_history:
+                self.solution_history.append(sol)
+            return jnp.zeros((), dtype=jnp.int32)
+
+        io_callback(append, jax.ShapeDtypeStruct((), jnp.int32), fitness, cand, ordered=True)
+
+    def _update_so(self, mstate, cand, fitness):
+        key_fit = fitness * self.opt_direction[0]  # minimize internally
+        if mstate.topk_fitness is None:
+            merged_key, merged_fit, merged_sol = key_fit, fitness, cand
+        else:
+            prev_key = mstate.topk_fitness * self.opt_direction[0]
+            merged_key = jnp.concatenate([prev_key, key_fit])
+            merged_fit = jnp.concatenate([mstate.topk_fitness, fitness])
+            merged_sol = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b]), mstate.topk_solution, cand
+            )
+        _, idx = jax.lax.top_k(-merged_key, self.topk)
+        return EvalMonitorState(
+            topk_fitness=merged_fit[idx],
+            topk_solution=jax.tree.map(lambda x: x[idx], merged_sol),
+            pf_count=None,
+        )
+
+    def _update_mo(self, mstate, cand, fitness):
+        key_fit = fitness * self.opt_direction
+        if mstate.topk_fitness is None:
+            prev_fit = jnp.full((self.pf_capacity,) + fitness.shape[1:], jnp.inf, fitness.dtype)
+            prev_sol = jax.tree.map(
+                lambda x: jnp.zeros((self.pf_capacity,) + x.shape[1:], x.dtype), cand
+            )
+        else:
+            prev_fit = mstate.topk_fitness * self.opt_direction
+            prev_sol = mstate.topk_solution
+        merged_fit = jnp.concatenate([prev_fit, key_fit])
+        merged_sol = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), prev_sol, cand)
+        # fixed-capacity archive refresh: one environmental selection
+        new_sol, new_fit = non_dominate(merged_sol, merged_fit, self.pf_capacity)
+        return EvalMonitorState(
+            topk_fitness=new_fit * self.opt_direction,  # store user direction
+            topk_solution=new_sol,
+            pf_count=jnp.sum(jnp.all(jnp.isfinite(new_fit), axis=-1).astype(jnp.int32)),
+        )
+
+    # --------------------------------------------------------------- getters
+    def get_best_fitness(self, mstate: EvalMonitorState) -> jax.Array:
+        return mstate.topk_fitness[0]
+
+    def get_topk_fitness(self, mstate: EvalMonitorState) -> jax.Array:
+        return mstate.topk_fitness
+
+    def get_best_solution(self, mstate: EvalMonitorState):
+        return jax.tree.map(lambda x: x[0], mstate.topk_solution)
+
+    def get_topk_solutions(self, mstate: EvalMonitorState):
+        return mstate.topk_solution
+
+    def get_pf_fitness(self, mstate: EvalMonitorState) -> jax.Array:
+        n = int(mstate.pf_count)
+        return mstate.topk_fitness[:n] if n else mstate.topk_fitness[:0]
+
+    def get_pf_solutions(self, mstate: EvalMonitorState):
+        n = int(mstate.pf_count)
+        return jax.tree.map(lambda x: x[:n], mstate.topk_solution)
+
+    def get_fitness_history(self) -> list:
+        jax.effects_barrier()
+        return self.fitness_history
+
+    def get_solution_history(self) -> list:
+        jax.effects_barrier()
+        return self.solution_history

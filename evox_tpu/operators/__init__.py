@@ -1,0 +1,3 @@
+from . import selection, crossover, mutation, sampling
+
+__all__ = ["selection", "crossover", "mutation", "sampling"]

@@ -1,0 +1,18 @@
+from .de_ops import de_diff_sum, de_bin_cross, de_exp_cross, de_arith_recom, differential_evolve, DifferentialEvolve
+from .sbx import simulated_binary, SimulatedBinary
+from .simple import one_point, uniform_rand_cross, OnePoint, UniformRand
+
+__all__ = [
+    "de_diff_sum",
+    "de_bin_cross",
+    "de_exp_cross",
+    "de_arith_recom",
+    "differential_evolve",
+    "DifferentialEvolve",
+    "simulated_binary",
+    "SimulatedBinary",
+    "one_point",
+    "uniform_rand_cross",
+    "OnePoint",
+    "UniformRand",
+]

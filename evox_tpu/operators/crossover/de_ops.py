@@ -1,0 +1,110 @@
+"""Differential-evolution building blocks (reference:
+src/evox/operators/crossover/differential_evolution.py:32+).
+
+All functions are batched over the whole population — no per-individual
+Python loops, so XLA fuses them into a handful of elementwise kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def de_diff_sum(
+    key: jax.Array,
+    diff_padding_num: int,
+    num_diff_vectors: jax.Array,
+    index: jax.Array,
+    population: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Sum of ``num_diff_vectors`` random difference pairs for each individual.
+
+    Returns ``(difference_sum, rand_vect_idx)`` where ``rand_vect_idx`` is the
+    first random index (used as the random base vector). ``diff_padding_num``
+    is the static max number of distinct random indices drawn (2*max_diffs+1).
+    """
+    pop_size, dim = population.shape[0], population.shape[-1]
+    select_len = num_diff_vectors.reshape(()) * 2 + 1
+
+    # draw diff_padding_num distinct-ish indices per row, avoiding self
+    random_choices = jax.random.randint(
+        key, (pop_size, diff_padding_num), 0, pop_size - 1
+    )
+    # shift indices >= own index by 1 to exclude self
+    own = index[:, None] if index.ndim == 1 else jnp.broadcast_to(index, (pop_size, 1))
+    rand_indices = jnp.where(random_choices >= own, random_choices + 1, random_choices)
+
+    pos = jnp.arange(diff_padding_num)
+    active = pos[None, :] < select_len  # (1, padding)
+    sign = jnp.where(pos % 2 == 1, 1.0, -1.0)  # idx1-idx2+idx3-idx4...
+    sign = sign.at[0].set(0.0)  # first is the base vector, not a diff term
+    # difference sum = sum over odd positions minus even (excluding pos 0)
+    vecs = population[rand_indices]  # (pop, padding, dim)
+    contrib = jnp.where(active[..., None], vecs * sign[None, :, None], 0.0)
+    difference_sum = jnp.sum(contrib, axis=1)
+    rand_vect_idx = rand_indices[:, 0]
+    return difference_sum, rand_vect_idx
+
+
+def de_bin_cross(key: jax.Array, mutant: jax.Array, parent: jax.Array, cr: jax.Array) -> jax.Array:
+    """Binomial crossover with guaranteed one mutant gene per row."""
+    pop_size, dim = mutant.shape
+    k1, k2 = jax.random.split(key)
+    mask = jax.random.uniform(k1, (pop_size, dim)) < jnp.broadcast_to(jnp.asarray(cr), (pop_size,))[:, None]
+    jrand = jax.random.randint(k2, (pop_size,), 0, dim)
+    mask = mask | (jnp.arange(dim)[None, :] == jrand[:, None])
+    return jnp.where(mask, mutant, parent)
+
+
+def de_exp_cross(key: jax.Array, mutant: jax.Array, parent: jax.Array, cr: jax.Array) -> jax.Array:
+    """Exponential crossover: a contiguous (wrapping) segment from the mutant.
+
+    Segment starts at a random position; its length L satisfies
+    P(L >= l) = cr^(l-1), sampled in closed form from a uniform.
+    """
+    pop_size, dim = mutant.shape
+    k1, k2 = jax.random.split(key)
+    start = jax.random.randint(k1, (pop_size, 1), 0, dim)
+    u = jax.random.uniform(k2, (pop_size, 1), minval=1e-12, maxval=1.0)
+    cr_b = jnp.broadcast_to(jnp.asarray(cr), (pop_size,))[:, None]
+    # geometric length in [1, dim]; cr >= 1 copies the whole mutant
+    length = jnp.clip(
+        jnp.floor(1.0 + jnp.log(u) / jnp.log(jnp.clip(cr_b, 1e-12, 1.0 - 1e-7))), 1, dim
+    ).astype(jnp.int32)
+    length = jnp.where(cr_b >= 1.0, dim, length)
+    offset = (jnp.arange(dim)[None, :] - start) % dim
+    mask = offset < length
+    return jnp.where(mask, mutant, parent)
+
+
+def de_arith_recom(mutant: jax.Array, parent: jax.Array, k: jax.Array) -> jax.Array:
+    """Arithmetic recombination: parent + K * (mutant - parent)."""
+    k = jnp.broadcast_to(jnp.asarray(k), (mutant.shape[0],))[:, None]
+    return parent + k * (mutant - parent)
+
+
+def differential_evolve(
+    key: jax.Array,
+    p1: jax.Array,
+    p2: jax.Array,
+    p3: jax.Array,
+    f: float,
+    cr: float,
+) -> jax.Array:
+    """Classic rand/1/bin step on explicit parent triples."""
+    mutant = p1 + f * (p2 - p3)
+    return de_bin_cross(key, mutant, p1, cr)
+
+
+class DifferentialEvolve:
+    """Class form of rand/1/bin (reference differential_evolution.py:32)."""
+
+    def __init__(self, f: float = 0.5, cr: float = 0.9):
+        self.f = f
+        self.cr = cr
+
+    def __call__(self, key, p1, p2, p3):
+        return differential_evolve(key, p1, p2, p3, self.f, self.cr)

@@ -1,0 +1,46 @@
+"""One-point and uniform crossover (reference: src/evox/operators/crossover/
+{one_point,uniform}.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def one_point(key: jax.Array, pop: jax.Array) -> jax.Array:
+    """One-point crossover over consecutive pairs."""
+    n, d = pop.shape
+    half = n // 2
+    p1, p2 = pop[0::2][:half], pop[1::2][:half]
+    point = jax.random.randint(key, (half, 1), 1, d)
+    mask = jnp.arange(d)[None, :] < point
+    c1 = jnp.where(mask, p1, p2)
+    c2 = jnp.where(mask, p2, p1)
+    out = jnp.empty_like(pop[: 2 * half]).at[0::2].set(c1).at[1::2].set(c2)
+    if 2 * half < n:
+        out = jnp.concatenate([out, pop[2 * half:]], axis=0)
+    return out
+
+
+def uniform_rand_cross(key: jax.Array, pop: jax.Array) -> jax.Array:
+    """Uniform crossover over consecutive pairs (50% gene swap)."""
+    n, d = pop.shape
+    half = n // 2
+    p1, p2 = pop[0::2][:half], pop[1::2][:half]
+    mask = jax.random.bernoulli(key, 0.5, (half, d))
+    c1 = jnp.where(mask, p1, p2)
+    c2 = jnp.where(mask, p2, p1)
+    out = jnp.empty_like(pop[: 2 * half]).at[0::2].set(c1).at[1::2].set(c2)
+    if 2 * half < n:
+        out = jnp.concatenate([out, pop[2 * half:]], axis=0)
+    return out
+
+
+class OnePoint:
+    def __call__(self, key, pop):
+        return one_point(key, pop)
+
+
+class UniformRand:
+    def __call__(self, key, pop):
+        return uniform_rand_cross(key, pop)

@@ -1,0 +1,3 @@
+from .ops import polynomial, gaussian, bitflip, Polynomial, Gaussian, Bitflip
+
+__all__ = ["polynomial", "gaussian", "bitflip", "Polynomial", "Gaussian", "Bitflip"]

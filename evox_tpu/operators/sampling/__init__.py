@@ -1,0 +1,5 @@
+from .uniform import UniformSampling
+from .latin_hypercube import LatinHypercubeSampling, latin_hypercube
+from .grid import GridSampling
+
+__all__ = ["UniformSampling", "LatinHypercubeSampling", "latin_hypercube", "GridSampling"]

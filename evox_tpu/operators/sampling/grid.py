@@ -1,0 +1,17 @@
+"""Grid sampling (reference: src/evox/operators/sampling/grid.py:6)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class GridSampling:
+    """Uniform grid over [0,1]^d with ``n_per_dim`` points per axis."""
+
+    def __init__(self, n_per_dim: int, d: int):
+        self.n_per_dim, self.d = n_per_dim, d
+
+    def __call__(self):
+        axes = [jnp.linspace(0.0, 1.0, self.n_per_dim)] * self.d
+        grid = jnp.stack(jnp.meshgrid(*axes, indexing="ij"), axis=-1)
+        return grid.reshape(-1, self.d)

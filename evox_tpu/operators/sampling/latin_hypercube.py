@@ -1,0 +1,23 @@
+"""Latin hypercube sampling (reference: src/evox/operators/sampling/
+latin_hypercude.py:7)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def latin_hypercube(key: jax.Array, n: int, d: int, smooth: bool = True) -> jax.Array:
+    """n points in [0,1]^d with one point per axis-stratum."""
+    k1, k2 = jax.random.split(key)
+    perms = jax.vmap(lambda k: jax.random.permutation(k, n))(jax.random.split(k1, d)).T  # (n, d)
+    offset = jax.random.uniform(k2, (n, d)) if smooth else 0.5
+    return (perms.astype(jnp.float32) + offset) / n
+
+
+class LatinHypercubeSampling:
+    def __init__(self, n: int, d: int, smooth: bool = True):
+        self.n, self.d, self.smooth = n, d, smooth
+
+    def __call__(self, key):
+        return latin_hypercube(key, self.n, self.d, self.smooth)

@@ -1,0 +1,63 @@
+"""Das–Dennis simplex-lattice reference vectors (reference:
+src/evox/operators/sampling/uniform.py:10-60).
+
+Runs host-side at construction / trace time (it is static data): generating
+all weight compositions is a combinatorial enumeration, not device math. The
+two-layer NBI fallback kicks in when a single layer would need H < m.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from math import comb
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _simplex_lattice(h: int, m: int) -> np.ndarray:
+    """All compositions of h into m nonnegative parts, divided by h."""
+    # stars and bars: choose bar positions among h+m-1 slots
+    combos = np.array(list(combinations(range(h + m - 1), m - 1)), dtype=np.int64)
+    if combos.size == 0:
+        return np.full((1, m), 1.0 / m)
+    edges = np.concatenate(
+        [
+            combos,
+            np.full((combos.shape[0], 1), h + m - 1, dtype=np.int64),
+        ],
+        axis=1,
+    )
+    prev = np.concatenate(
+        [np.full((combos.shape[0], 1), -1, dtype=np.int64), combos], axis=1
+    )
+    parts = edges - prev - 1
+    return parts.astype(np.float64) / h
+
+
+class UniformSampling:
+    """``UniformSampling(n, m)() -> (weights (n', m), n')`` with n' ≈ n."""
+
+    def __init__(self, n: int, m: int):
+        self.n = n
+        self.m = m
+
+    def __call__(self) -> Tuple[jax.Array, int]:
+        m, n = self.m, self.n
+        h1 = 1
+        while comb(h1 + m, m - 1) <= n:
+            h1 += 1
+        w = _simplex_lattice(h1, m)
+        if h1 < m:
+            # two-layer NBI: add an inner layer shrunk toward the centroid
+            h2 = 0
+            while comb(h1 + m - 1, m - 1) + comb(h2 + m, m - 1) <= n:
+                h2 += 1
+            if h2 > 0:
+                w2 = _simplex_lattice(h2, m)
+                w2 = w2 / 2.0 + 1.0 / (2.0 * m)
+                w = np.concatenate([w, w2], axis=0)
+        w = np.maximum(w, 1e-6)
+        return jnp.asarray(w, dtype=jnp.float32), w.shape[0]

@@ -1,0 +1,31 @@
+from .non_dominate import (
+    non_dominated_sort,
+    crowding_distance,
+    crowding_distance_sort,
+    non_dominate,
+    non_dominate_indices,
+    NonDominate,
+)
+from .basic import (
+    tournament,
+    tournament_multifit,
+    roulette_wheel,
+    topk_fit,
+    uniform_rand,
+    select_rand_pbest,
+)
+
+__all__ = [
+    "non_dominated_sort",
+    "crowding_distance",
+    "crowding_distance_sort",
+    "non_dominate",
+    "non_dominate_indices",
+    "NonDominate",
+    "tournament",
+    "tournament_multifit",
+    "roulette_wheel",
+    "topk_fit",
+    "uniform_rand",
+    "select_rand_pbest",
+]

@@ -1,0 +1,133 @@
+"""Non-dominated sorting + crowding distance (reference:
+src/evox/operators/selection/non_dominate.py:13-232).
+
+TPU-first formulation: the dominance matrix is built with a fully vectorized
+broadcast-compare, and front peeling runs as a ``lax.while_loop`` whose body
+is a single f32 matvec over the dominance matrix — so each peel iteration is
+one MXU-friendly contraction instead of data-dependent gather/scatter. No
+host fallback is needed (the reference's "host" numpy mode exists because
+data-dependent loops were slow on its backends; XLA:TPU handles the
+while_loop natively).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.common import dominate_relation
+
+INF = jnp.inf
+
+
+def non_dominated_sort(fitness: jax.Array) -> jax.Array:
+    """Pareto-rank each row of ``fitness`` (n, m); rank 0 = non-dominated.
+
+    Minimization convention.
+    """
+    n = fitness.shape[0]
+    dom = dominate_relation(fitness, fitness)  # (n, n) bool: i dominates j
+    dom_f = dom.astype(jnp.float32)
+    count = jnp.sum(dom_f, axis=0)  # how many dominate j
+    rank = jnp.zeros((n,), dtype=jnp.int32)
+    front = count == 0.0
+
+    def cond(carry):
+        _, _, front, _ = carry
+        return jnp.any(front)
+
+    def body(carry):
+        rank, count, front, r = carry
+        rank = jnp.where(front, r, rank)
+        front_f = front.astype(jnp.float32)
+        # remove current front's domination counts in one matvec,
+        # and push processed rows to -1 so they never re-enter
+        count = count - front_f @ dom_f - front_f
+        return rank, count, count == 0.0, r + 1
+
+    rank, _, _, _ = jax.lax.while_loop(cond, body, (rank, count, front, jnp.int32(0)))
+    return rank
+
+
+def crowding_distance(fitness: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    """NSGA-II crowding distance per individual (n,), larger = less crowded.
+
+    ``mask``: boolean (n,) — individuals outside the mask get ``-inf`` so they
+    sort last; boundary individuals of each objective get ``+inf``.
+    (reference: non_dominate.py:118-158)
+    """
+    n, m = fitness.shape
+    if mask is None:
+        mask = jnp.ones((n,), dtype=bool)
+    num_valid = jnp.sum(mask.astype(jnp.int32))
+    pos = jnp.arange(n)
+
+    def per_objective(fv):
+        fv_masked = jnp.where(mask, fv, INF)
+        order = jnp.argsort(fv_masked)
+        s = fv_masked[order]
+        last = jnp.maximum(num_valid - 1, 0)
+        f_range = jnp.maximum(s[last] - s[0], 1e-12)
+        inner = (s[2:] - s[:-2]) / f_range
+        d_sorted = jnp.concatenate([jnp.full((1,), INF), inner, jnp.full((1,), INF)])
+        d_sorted = jnp.where(pos == last, INF, d_sorted)
+        d_sorted = jnp.where(pos >= num_valid, -INF, d_sorted)
+        d_sorted = jnp.nan_to_num(d_sorted, nan=0.0, posinf=INF, neginf=-INF)
+        return jnp.zeros((n,)).at[order].set(d_sorted)
+
+    return jnp.sum(jax.vmap(per_objective)(fitness.T), axis=0)
+
+
+def crowding_distance_sort(fitness: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    """Indices sorted by descending crowding distance (reference :161-180)."""
+    return jnp.argsort(-crowding_distance(fitness, mask))
+
+
+def non_dominate_indices(
+    fitness: jax.Array,
+    topk: int,
+    pop: Optional[jax.Array] = None,
+    deduplicate: bool = False,
+) -> jax.Array:
+    """Indices of the ``topk`` best by (rank, -crowding) environmental
+    selection. With ``deduplicate`` (requires ``pop``), duplicate decision
+    vectors are pushed to the back before ranking."""
+    if deduplicate:
+        n = pop.shape[0]
+        _, idx = jnp.unique(pop, axis=0, size=n, return_index=True, fill_value=jnp.nan)
+        is_first = jnp.zeros((n,), dtype=bool).at[idx].set(True)
+        fitness = jnp.where(is_first[:, None], fitness, INF)
+    rank = non_dominated_sort(fitness)
+    # crowding ties-break only matters within the worst admitted rank
+    worst_rank = jnp.sort(rank)[topk - 1]
+    crowd = crowding_distance(fitness, mask=rank == worst_rank)
+    return jnp.lexsort((-crowd, rank))[:topk]
+
+
+def non_dominate(
+    pop: jax.Array,
+    fitness: jax.Array,
+    topk: int,
+    deduplicate: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Environmental selection: keep the ``topk`` best by (rank, -crowding).
+
+    (reference: non_dominate.py:183-222). ``pop`` may be a pytree with a
+    leading population axis.
+    """
+    pop_leaf = pop if isinstance(pop, jax.Array) else jax.tree.leaves(pop)[0]
+    order = non_dominate_indices(fitness, topk, pop_leaf, deduplicate)
+    return jax.tree.map(lambda x: x[order], pop), fitness[order]
+
+
+class NonDominate:
+    """Class-form environmental selector (reference: non_dominate.py:225-232)."""
+
+    def __init__(self, topk: int, deduplicate: bool = False):
+        self.topk = topk
+        self.deduplicate = deduplicate
+
+    def __call__(self, pop, fitness):
+        return non_dominate(pop, fitness, self.topk, self.deduplicate)

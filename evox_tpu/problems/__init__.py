@@ -1,0 +1,3 @@
+from . import numerical
+
+__all__ = ["numerical"]

@@ -1,0 +1,29 @@
+from .basic import (
+    Ackley,
+    Rastrigin,
+    Sphere,
+    Griewank,
+    Rosenbrock,
+    Schwefel,
+    ackley_func,
+    rastrigin_func,
+    sphere_func,
+    griewank_func,
+    rosenbrock_func,
+    schwefel_func,
+)
+
+__all__ = [
+    "Ackley",
+    "Rastrigin",
+    "Sphere",
+    "Griewank",
+    "Rosenbrock",
+    "Schwefel",
+    "ackley_func",
+    "rastrigin_func",
+    "sphere_func",
+    "griewank_func",
+    "rosenbrock_func",
+    "schwefel_func",
+]

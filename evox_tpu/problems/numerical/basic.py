@@ -1,0 +1,79 @@
+"""Classic single-objective benchmark functions (reference:
+src/evox/problems/numerical/{ackley,rastrigin,sphere,griewank,rosenbrock,
+schwefel}.py). Each ships as a pure per-individual function plus a
+``Problem`` class whose ``evaluate`` is a whole-population vectorized
+expression (batched over pop — XLA maps it onto the VPU/MXU directly).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.problem import Problem
+
+
+def ackley_func(x: jax.Array, a: float = 20.0, b: float = 0.2, c: float = 2.0 * jnp.pi) -> jax.Array:
+    d = x.shape[-1]
+    return (
+        -a * jnp.exp(-b * jnp.sqrt(jnp.mean(x**2, axis=-1)))
+        - jnp.exp(jnp.mean(jnp.cos(c * x), axis=-1))
+        + a
+        + jnp.e
+    )
+
+
+def rastrigin_func(x: jax.Array) -> jax.Array:
+    return 10.0 * x.shape[-1] + jnp.sum(x**2 - 10.0 * jnp.cos(2.0 * jnp.pi * x), axis=-1)
+
+
+def sphere_func(x: jax.Array) -> jax.Array:
+    return jnp.sum(x**2, axis=-1)
+
+
+def griewank_func(x: jax.Array) -> jax.Array:
+    d = x.shape[-1]
+    i = jnp.arange(1, d + 1, dtype=x.dtype)
+    return 1.0 + jnp.sum(x**2, axis=-1) / 4000.0 - jnp.prod(jnp.cos(x / jnp.sqrt(i)), axis=-1)
+
+
+def rosenbrock_func(x: jax.Array) -> jax.Array:
+    return jnp.sum(
+        100.0 * (x[..., 1:] - x[..., :-1] ** 2) ** 2 + (1.0 - x[..., :-1]) ** 2, axis=-1
+    )
+
+
+def schwefel_func(x: jax.Array) -> jax.Array:
+    d = x.shape[-1]
+    return 418.9828872724338 * d - jnp.sum(x * jnp.sin(jnp.sqrt(jnp.abs(x))), axis=-1)
+
+
+class _FuncProblem(Problem):
+    _func = None
+
+    def evaluate(self, state, pop):
+        return type(self)._func(pop), state
+
+
+class Ackley(_FuncProblem):
+    _func = staticmethod(ackley_func)
+
+
+class Rastrigin(_FuncProblem):
+    _func = staticmethod(rastrigin_func)
+
+
+class Sphere(_FuncProblem):
+    _func = staticmethod(sphere_func)
+
+
+class Griewank(_FuncProblem):
+    _func = staticmethod(griewank_func)
+
+
+class Rosenbrock(_FuncProblem):
+    _func = staticmethod(rosenbrock_func)
+
+
+class Schwefel(_FuncProblem):
+    _func = staticmethod(schwefel_func)

@@ -1,0 +1,32 @@
+from .common import (
+    TreeAndVector,
+    parse_opt_direction,
+    rank_based_fitness,
+    min_by,
+    compose,
+    pairwise_euclidean_dist,
+    pairwise_manhattan_dist,
+    pairwise_chebyshev_dist,
+    cos_dist,
+    dominate_relation,
+    new_key,
+)
+from .aggregation import AggregationFunction
+from .optimizers import clipup, make_optimizer
+
+__all__ = [
+    "TreeAndVector",
+    "parse_opt_direction",
+    "rank_based_fitness",
+    "min_by",
+    "compose",
+    "pairwise_euclidean_dist",
+    "pairwise_manhattan_dist",
+    "pairwise_chebyshev_dist",
+    "cos_dist",
+    "dominate_relation",
+    "new_key",
+    "AggregationFunction",
+    "clipup",
+    "make_optimizer",
+]

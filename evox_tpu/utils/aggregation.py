@@ -1,0 +1,57 @@
+"""Scalarization / aggregation functions for decomposition-based MOEAs
+(reference: src/evox/utils/common.py:264-310). Each maps
+``(fitness (n, m), weights (n, m), ideal (m,) [, nadir (m,)])`` → ``(n,)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-6
+
+
+def weighted_sum(f: jax.Array, w: jax.Array, ideal=None, nadir=None) -> jax.Array:
+    return jnp.sum(f * w, axis=-1)
+
+
+def tchebycheff(f: jax.Array, w: jax.Array, ideal: jax.Array, nadir=None) -> jax.Array:
+    return jnp.max(jnp.abs(f - ideal) * w, axis=-1)
+
+
+def tchebycheff_norm(f: jax.Array, w: jax.Array, ideal: jax.Array, nadir: jax.Array) -> jax.Array:
+    return jnp.max(jnp.abs(f - ideal) / jnp.maximum(nadir - ideal, EPS) * w, axis=-1)
+
+
+def modified_tchebycheff(f: jax.Array, w: jax.Array, ideal: jax.Array, nadir=None) -> jax.Array:
+    return jnp.max(jnp.abs(f - ideal) / jnp.maximum(w, EPS), axis=-1)
+
+
+def pbi(f: jax.Array, w: jax.Array, ideal: jax.Array, nadir=None, theta: float = 5.0) -> jax.Array:
+    norm_w = jnp.linalg.norm(w, axis=-1)
+    diff = f - ideal
+    d1 = jnp.sum(diff * w, axis=-1) / jnp.maximum(norm_w, EPS)
+    d2 = jnp.linalg.norm(diff - d1[..., None] * w / jnp.maximum(norm_w, EPS)[..., None], axis=-1)
+    return d1 + theta * d2
+
+
+_FUNCS = {
+    "weighted_sum": weighted_sum,
+    "tchebycheff": tchebycheff,
+    "tchebycheff_norm": tchebycheff_norm,
+    "modified_tchebycheff": modified_tchebycheff,
+    "pbi": pbi,
+}
+
+
+class AggregationFunction:
+    """Callable wrapper selecting an aggregation function by name."""
+
+    def __init__(self, name: str):
+        if name not in _FUNCS:
+            raise ValueError(f"unknown aggregation function {name!r}; options: {sorted(_FUNCS)}")
+        self.name = name
+        self.func = _FUNCS[name]
+
+    def __call__(self, f, w, ideal=None, nadir=None):
+        return self.func(f, w, ideal, nadir)

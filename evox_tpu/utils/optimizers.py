@@ -1,0 +1,67 @@
+"""Gradient-step optimizers for ES-style algorithms.
+
+The reference wraps optax behind a ``Stateful`` (``OptaxWrapper``,
+reference: src/evox/utils/common.py:142-153) and hand-rolls ClipUp
+(reference: src/evox/algorithms/so/es_variants/pgpe.py:34-64). Here both are
+plain ``optax.GradientTransformation``s — the idiomatic JAX form — so every
+ES algorithm just keeps an ``opt_state`` leaf in its own pytree state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class ClipUpState(NamedTuple):
+    velocity: jax.Array
+
+
+def clipup(
+    learning_rate: float = 0.15,
+    momentum: float = 0.9,
+    max_speed: float = 0.3,
+    fix_gradient_size: bool = True,
+) -> optax.GradientTransformation:
+    """ClipUp (Toklu et al. 2020): normalized gradient + clipped velocity."""
+
+    def init_fn(params):
+        return ClipUpState(velocity=jax.tree.map(jnp.zeros_like, params))
+
+    def update_fn(grads, state, params=None):
+        del params
+
+        def upd(g, v):
+            if fix_gradient_size:
+                g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)
+            v = momentum * v + learning_rate * g
+            speed = jnp.linalg.norm(v)
+            v = jnp.where(speed > max_speed, v * (max_speed / speed), v)
+            return v
+
+        velocity = jax.tree.map(upd, grads, state.velocity)
+        # optax convention: updates are *added* to params
+        return jax.tree.map(jnp.negative, velocity), ClipUpState(velocity)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def make_optimizer(
+    optimizer: Union[str, optax.GradientTransformation],
+    learning_rate: float = 0.01,
+    **kwargs,
+) -> optax.GradientTransformation:
+    """Resolve a name ('adam', 'sgd', 'clipup', …) or pass through an optax
+    transformation. Note: ES algorithms *minimize*, and gradients passed in
+    are descent directions, so plain optax semantics apply."""
+    if isinstance(optimizer, optax.GradientTransformation):
+        return optimizer
+    if optimizer == "clipup":
+        return clipup(learning_rate=learning_rate, **kwargs)
+    factory = getattr(optax, optimizer, None)
+    if factory is None:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+    return factory(learning_rate, **kwargs)

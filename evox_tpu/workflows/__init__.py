@@ -1,0 +1,3 @@
+from .std import StdWorkflow, StdWorkflowState
+
+__all__ = ["StdWorkflow", "StdWorkflowState"]

@@ -1,0 +1,202 @@
+"""StdWorkflow — the single-program, mesh-native orchestration loop.
+
+Capability parity with the reference's ``StdWorkflow`` (reference:
+src/evox/workflows/std_workflow.py) **and** its ``RayDistributedWorkflow``
+(reference: src/evox/workflows/distributed.py), redesigned for TPU:
+
+- The whole ask → evaluate → tell generation is ONE jitted function over a
+  global ``jax.sharding.Mesh``. No pmap, no per-rank slicing, no Ray RPC.
+- The candidate population is constrained to a ``NamedSharding`` over the
+  ``"pop"`` mesh axis before evaluation; GSPMD partitions the (vmapped)
+  evaluation across all devices and inserts the fitness all-gather over ICI
+  where the algorithm's ``tell`` consumes it globally — this replaces the
+  reference's ``lax.dynamic_slice_in_dim`` + ``lax.all_gather`` pmap dance
+  (std_workflow.py:160,189-200) and the entire Ray object-store path.
+- Multi-host: initialize ``jax.distributed`` (core/distributed.py), build the
+  mesh over all pod devices, run the same program — collectives ride
+  ICI within a slice, DCN across slices.
+- Host-side (non-jittable) problems run through ``jax.pure_callback`` with a
+  declared fitness shape, same contract as the reference's
+  ``external_problem=True`` (std_workflow.py:146-158).
+- Monitors follow the reference's 8-hook spec but their state is an
+  on-device pytree threaded through the step (core/monitor.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.algorithm import Algorithm
+from ..core.monitor import Monitor
+from ..core.problem import Problem
+from ..core.struct import PyTreeNode, static_field, field
+from ..core.distributed import shard_pop
+from ..utils.common import parse_opt_direction
+
+
+class StdWorkflowState(PyTreeNode):
+    generation: jax.Array
+    algo: Any
+    prob: Any
+    monitors: Tuple[Any, ...]
+    first_step: bool = static_field(default=True)
+
+
+class StdWorkflow:
+    """Compose algorithm + problem + monitors into a jitted, sharded step.
+
+    Args:
+        algorithm: an :class:`~evox_tpu.core.Algorithm`.
+        problem: a :class:`~evox_tpu.core.Problem`.
+        monitors: monitors implementing the 8-hook spec.
+        opt_direction: ``"min"`` / ``"max"`` or a per-objective list; fitness
+            is multiplied by the resulting ±1 vector before ``tell`` so
+            algorithms always minimize.
+        pop_transforms: applied to candidates before evaluation (e.g.
+            ``TreeAndVector.batched_to_tree`` for neuroevolution).
+        fit_transforms: applied to the sign-flipped fitness before ``tell``
+            (e.g. ``rank_based_fitness``).
+        mesh: a ``jax.sharding.Mesh`` with a ``"pop"`` axis. When given, the
+            candidate batch and fitness are sharded over it.
+        external_problem: force the ``pure_callback`` evaluation path;
+            defaults to ``not problem.jittable``.
+        num_objectives: fitness arity used to declare callback output shapes.
+        jit_step: disable to debug eagerly.
+    """
+
+    def __init__(
+        self,
+        algorithm: Algorithm,
+        problem: Problem,
+        monitors: Sequence[Monitor] = (),
+        opt_direction: Any = "min",
+        pop_transforms: Sequence[Callable] = (),
+        fit_transforms: Sequence[Callable] = (),
+        mesh: Optional[jax.sharding.Mesh] = None,
+        external_problem: Optional[bool] = None,
+        num_objectives: int = 1,
+        jit_step: bool = True,
+    ):
+        self.algorithm = algorithm
+        self.problem = problem
+        self.monitors = tuple(monitors)
+        self.opt_direction = parse_opt_direction(opt_direction)
+        self.pop_transforms = tuple(pop_transforms)
+        self.fit_transforms = tuple(fit_transforms)
+        self.mesh = mesh
+        self.num_objectives = num_objectives
+        self.external = (not problem.jittable) if external_problem is None else external_problem
+        for m in self.monitors:
+            m.set_opt_direction(self.opt_direction)
+        self._hook_table = {
+            name: tuple(i for i, m in enumerate(self.monitors) if name in m.hooks())
+            for name in (
+                "pre_step",
+                "pre_ask",
+                "post_ask",
+                "pre_eval",
+                "post_eval",
+                "pre_tell",
+                "post_tell",
+                "post_step",
+            )
+        }
+        self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
+
+    # ------------------------------------------------------------------ init
+    def init(self, key: jax.Array) -> StdWorkflowState:
+        keys = jax.random.split(key, 2 + len(self.monitors))
+        return StdWorkflowState(
+            generation=jnp.zeros((), dtype=jnp.int32),
+            algo=self.algorithm.init(keys[0]),
+            prob=self.problem.init(keys[1]),
+            monitors=tuple(m.init(k) for m, k in zip(self.monitors, keys[2:])),
+            first_step=True,
+        )
+
+    # ------------------------------------------------------------------ step
+    def step(self, state: StdWorkflowState) -> StdWorkflowState:
+        return self._step(state)
+
+    def _run_hooks(self, name: str, mstates: list, *args: Any) -> None:
+        for i in self._hook_table[name]:
+            mstates[i] = getattr(self.monitors[i], name)(mstates[i], *args)
+
+    def _flip(self, fitness: jax.Array) -> jax.Array:
+        if fitness.ndim == 1:
+            return fitness * self.opt_direction[0]
+        return fitness * self.opt_direction
+
+    def _evaluate(self, pstate: Any, cand: Any) -> Tuple[jax.Array, Any]:
+        if not self.external:
+            return self.problem.evaluate(pstate, cand)
+        # Host-side problem via pure_callback with a declared output signature.
+        # The problem state is passed through the callback as an operand (it
+        # would otherwise be a captured tracer); any state *update* stays on
+        # the host object itself — external problems are stateless from the
+        # jit program's point of view, same contract as the reference
+        # (std_workflow.py:146-158).
+        leaves = jax.tree.leaves(cand)
+        pop_size = leaves[0].shape[0]
+        if self.num_objectives > 1:
+            shape = (pop_size, self.num_objectives)
+        else:
+            shape = self.problem.fit_shape(pop_size)
+        result_sds = jax.ShapeDtypeStruct(shape, jnp.dtype(self.problem.fit_dtype))
+
+        def host_eval(ps, c):
+            fit, _ = self.problem.evaluate(ps, c)
+            return np.asarray(fit, dtype=self.problem.fit_dtype)
+
+        fitness = jax.pure_callback(host_eval, result_sds, pstate, cand)
+        return fitness, pstate
+
+    def _step_impl(self, state: StdWorkflowState) -> StdWorkflowState:
+        mstates = list(state.monitors)
+        self._run_hooks("pre_step", mstates)
+        self._run_hooks("pre_ask", mstates)
+
+        use_init = state.first_step and (
+            self.algorithm.has_init_ask or self.algorithm.has_init_tell
+        )
+        if use_init:
+            pop, astate = self.algorithm.init_ask(state.algo)
+        else:
+            pop, astate = self.algorithm.ask(state.algo)
+        self._run_hooks("post_ask", mstates, pop)
+
+        cand = pop
+        for t in self.pop_transforms:
+            cand = t(cand)
+        cand = shard_pop(cand, self.mesh)
+
+        self._run_hooks("pre_eval", mstates, cand)
+        fitness, pstate = self._evaluate(state.prob, cand)
+        fitness = shard_pop(fitness, self.mesh)
+        self._run_hooks("post_eval", mstates, cand, fitness)
+
+        fitness = self._flip(fitness)
+        for t in self.fit_transforms:
+            fitness = t(fitness)
+        self._run_hooks("pre_tell", mstates, fitness)
+
+        if use_init:
+            astate = self.algorithm.init_tell(astate, fitness)
+        else:
+            astate = self.algorithm.tell(astate, fitness)
+        self._run_hooks("post_tell", mstates)
+
+        new_state = state.replace(
+            generation=state.generation + 1,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(mstates),
+            first_step=False,
+        )
+        mstates = list(new_state.monitors)
+        self._run_hooks("post_step", mstates, new_state)
+        return new_state.replace(monitors=tuple(mstates))

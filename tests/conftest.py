@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh so sharded
+workflows and shard_map collectives are exercised without TPU hardware
+(the multi-chip test story the reference lacks — SURVEY.md §4).
+
+Note: jax may already be imported by pytest plugins, so the platform is
+forced via ``jax.config`` (still before any backend is initialized), not
+just env vars.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
