@@ -1,4 +1,32 @@
 from . import so
 from .so.pso import PSO, CSO
+from .so.es import (
+    OpenES,
+    PGPE,
+    CMAES,
+    SepCMAES,
+    IPOPCMAES,
+    BIPOPCMAES,
+    RestartCMAESDriver,
+    XNES,
+    SeparableNES,
+    SNES,
+    ARS,
+)
 
-__all__ = ["so", "PSO", "CSO"]
+__all__ = [
+    "so",
+    "PSO",
+    "CSO",
+    "OpenES",
+    "PGPE",
+    "CMAES",
+    "SepCMAES",
+    "IPOPCMAES",
+    "BIPOPCMAES",
+    "RestartCMAESDriver",
+    "XNES",
+    "SeparableNES",
+    "SNES",
+    "ARS",
+]

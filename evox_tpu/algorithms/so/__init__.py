@@ -1,4 +1,5 @@
 from .pso import *  # noqa: F401,F403
-from . import pso
+from .es import *  # noqa: F401,F403
+from . import pso, es
 
-__all__ = ["pso"]
+__all__ = ["pso", "es"]

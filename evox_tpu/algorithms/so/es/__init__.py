@@ -1,0 +1,21 @@
+from .open_es import OpenES
+from .pgpe import PGPE, ClipUp
+from .cma_es import CMAES, SepCMAES, RestartCMAESDriver, IPOPCMAES, BIPOPCMAES
+from .nes import XNES, SeparableNES
+from .snes import SNES
+from .ars import ARS
+
+__all__ = [
+    "OpenES",
+    "PGPE",
+    "ClipUp",
+    "CMAES",
+    "SepCMAES",
+    "RestartCMAESDriver",
+    "IPOPCMAES",
+    "BIPOPCMAES",
+    "XNES",
+    "SeparableNES",
+    "SNES",
+    "ARS",
+]

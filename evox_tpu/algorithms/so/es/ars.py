@@ -1,0 +1,68 @@
+"""ARS — Augmented Random Search (Mania, Guy & Recht 2018,
+arXiv:1803.07055), the V1-t / V2-t "top directions" variant.
+
+Capability parity with reference src/evox/algorithms/so/es_variants/ars.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+
+
+class ARSState(PyTreeNode):
+    center: jax.Array
+    delta: jax.Array
+    key: jax.Array
+
+
+class ARS(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        pop_size: int,
+        elite_ratio: float = 0.1,
+        learning_rate: float = 0.05,
+        noise_stdev: float = 0.03,
+    ):
+        assert pop_size % 2 == 0, "ARS evaluates +/- direction pairs"
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.pop_size = pop_size
+        self.n_dirs = pop_size // 2
+        self.top_k = max(1, int(self.n_dirs * elite_ratio))
+        self.learning_rate = learning_rate
+        self.noise_stdev = noise_stdev
+
+    def init(self, key: jax.Array) -> ARSState:
+        return ARSState(
+            center=self.center_init,
+            delta=jnp.zeros((self.n_dirs, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: ARSState) -> Tuple[jax.Array, ARSState]:
+        key, k = jax.random.split(state.key)
+        delta = jax.random.normal(k, (self.n_dirs, self.dim))
+        pop = jnp.concatenate(
+            [state.center + self.noise_stdev * delta,
+             state.center - self.noise_stdev * delta],
+            axis=0,
+        )
+        return pop, state.replace(delta=delta, key=key)
+
+    def tell(self, state: ARSState, fitness: jax.Array) -> ARSState:
+        f_pos, f_neg = fitness[: self.n_dirs], fitness[self.n_dirs :]
+        # best direction = smallest min(f+, f-) under minimization
+        score = jnp.minimum(f_pos, f_neg)
+        _, top = jax.lax.top_k(-score, self.top_k)
+        fp, fn, d = f_pos[top], f_neg[top], state.delta[top]
+        sigma_r = jnp.std(jnp.concatenate([fp, fn])) + 1e-8
+        grad = (fp - fn) @ d / self.top_k  # descent direction for minimization
+        center = state.center - self.learning_rate / sigma_r * grad
+        return state.replace(center=center)

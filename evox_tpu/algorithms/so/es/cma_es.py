@@ -1,0 +1,346 @@
+"""CMA-ES family (Hansen, "The CMA Evolution Strategy: A Tutorial",
+arXiv:1604.00772).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/cma_es.py
+(CMAES, SepCMAES, IPOP/BIPOP restarts), TPU-first design choices:
+
+- the full generation (ask + tell) is pure and jit/scan-compatible;
+- eigendecomposition of C is *lazy*: performed every ``decomp_per_iter``
+  generations inside ``lax.cond`` (both per the tutorial's amortization rule
+  and because ``eigh`` is the one op here that does not love the MXU);
+- restarts: jit-compatible in-place restart on stagnation (same pop size,
+  static shapes) plus a host-level :class:`RestartCMAESDriver` implementing
+  true IPOP/BIPOP population growth (a new pop size means a new compiled
+  program on TPU, so growth lives outside jit by design — unlike the
+  reference, which also keeps pop_size fixed inside its IPOP `tell` and is
+  noted buggy there, SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+
+
+def _default_pop_size(dim: int) -> int:
+    return 4 + math.floor(3 * math.log(dim))
+
+
+class CMAESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    pc: jax.Array
+    ps: jax.Array
+    C: jax.Array
+    B: jax.Array
+    D: jax.Array
+    z: jax.Array  # standardized samples of the current generation
+    iteration: jax.Array
+    key: jax.Array
+
+
+class CMAES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        recombination_weights=None,
+        cm: float = 1.0,
+        decomp_per_iter: Optional[int] = None,
+    ):
+        assert init_stdev > 0
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = pop_size or _default_pop_size(self.dim)
+        self.cm = cm
+        n, lam = self.dim, self.pop_size
+
+        if recombination_weights is None:
+            mu = lam // 2
+            w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
+            w = w / jnp.sum(w)
+        else:
+            w = jnp.asarray(recombination_weights, dtype=jnp.float32)
+            mu = int(w.shape[0])
+        self.mu = mu
+        self.weights = w
+        self.mueff = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
+
+        me = self.mueff
+        self.cc = (4 + me / n) / (n + 4 + 2 * me / n)
+        self.cs = (me + 2) / (n + me + 5)
+        self.c1 = 2 / ((n + 1.3) ** 2 + me)
+        self.cmu = min(1 - self.c1, 2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me))
+        self.damps = 1 + 2 * max(0.0, math.sqrt((me - 1) / (n + 1)) - 1) + self.cs
+        self.chiN = math.sqrt(n) * (1 - 1 / (4 * n) + 1 / (21 * n**2))
+        if decomp_per_iter is None:
+            decomp_per_iter = max(1, round(1 / ((self.c1 + self.cmu) * n * 10)))
+        self.decomp_per_iter = decomp_per_iter
+
+    # ------------------------------------------------------------------ api
+    def init(self, key: jax.Array) -> CMAESState:
+        n = self.dim
+        return CMAESState(
+            mean=self.center_init,
+            sigma=jnp.asarray(self.init_stdev, dtype=jnp.float32),
+            pc=jnp.zeros((n,)),
+            ps=jnp.zeros((n,)),
+            C=jnp.eye(n),
+            B=jnp.eye(n),
+            D=jnp.ones((n,)),
+            z=jnp.zeros((self.pop_size, n)),
+            iteration=jnp.zeros((), dtype=jnp.int32),
+            key=key,
+        )
+
+    def ask(self, state: CMAESState) -> Tuple[jax.Array, CMAESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        # x_i = mean + sigma * B (D ⊙ z_i)   — batched as one matmul (MXU)
+        y = (z * state.D) @ state.B.T
+        pop = state.mean + state.sigma * y
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: CMAESState, fitness: jax.Array) -> CMAESState:
+        n = self.dim
+        order = jnp.argsort(fitness)
+        z_sorted = state.z[order][: self.mu]
+        y_sorted = (z_sorted * state.D) @ state.B.T
+        y_w = self.weights @ y_sorted
+        mean = state.mean + self.cm * state.sigma * y_w
+
+        # invsqrtC @ y_w == B z_w because y = B D z
+        z_w = self.weights @ z_sorted
+        ps = (1 - self.cs) * state.ps + math.sqrt(
+            self.cs * (2 - self.cs) * self.mueff
+        ) * (state.B @ z_w)
+        it = state.iteration + 1
+        ps_norm = jnp.linalg.norm(ps)
+        hsig = ps_norm / jnp.sqrt(1 - (1 - self.cs) ** (2 * it.astype(jnp.float32))) < (
+            1.4 + 2 / (n + 1)
+        ) * self.chiN
+        hsig = hsig.astype(jnp.float32)
+        pc = (1 - self.cc) * state.pc + hsig * math.sqrt(
+            self.cc * (2 - self.cc) * self.mueff
+        ) * y_w
+
+        rank_mu = (y_sorted * self.weights[:, None]).T @ y_sorted
+        C = (
+            (1 - self.c1 - self.cmu) * state.C
+            + self.c1
+            * (jnp.outer(pc, pc) + (1 - hsig) * self.cc * (2 - self.cc) * state.C)
+            + self.cmu * rank_mu
+        )
+        sigma = state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1))
+
+        B, D = jax.lax.cond(
+            it % self.decomp_per_iter == 0,
+            lambda: self._decompose(C),
+            lambda: (state.B, state.D),
+        )
+        return state.replace(
+            mean=mean, sigma=sigma, pc=pc, ps=ps, C=C, B=B, D=D, iteration=it,
+        )
+
+    @staticmethod
+    def _decompose(C: jax.Array):
+        C = (C + C.T) / 2.0
+        eigvals, B = jnp.linalg.eigh(C)
+        D = jnp.sqrt(jnp.maximum(eigvals, 1e-20))
+        return B, D
+
+
+class SepCMAESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    pc: jax.Array
+    ps: jax.Array
+    C: jax.Array  # diagonal of the covariance
+    z: jax.Array
+    iteration: jax.Array
+    key: jax.Array
+
+
+class SepCMAES(Algorithm):
+    """Separable (diagonal-covariance) CMA-ES — O(d) memory, for very high
+    dimension (Ros & Hansen 2008). Reference cma_es.py:200-253."""
+
+    def __init__(self, center_init, init_stdev: float, pop_size: Optional[int] = None):
+        assert init_stdev > 0
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = pop_size or _default_pop_size(self.dim)
+        n, lam = self.dim, self.pop_size
+        mu = lam // 2
+        w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
+        w = w / jnp.sum(w)
+        self.mu, self.weights = mu, w
+        me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
+        self.mueff = me
+        self.cc = (4 + me / n) / (n + 4 + 2 * me / n)
+        self.cs = (me + 2) / (n + me + 5)
+        # separable variant: covariance learning rate scaled up by (n+2)/3
+        self.ccov = (n + 2) / 3 * min(
+            1.0, 2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me) + 2 / ((n + 1.3) ** 2 + me)
+        )
+        self.c1 = self.ccov * 2 / ((n + 1.3) ** 2 + me) / (
+            2 / ((n + 1.3) ** 2 + me) + min(1.0, 2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me))
+        )
+        self.cmu = self.ccov - self.c1
+        self.damps = 1 + 2 * max(0.0, math.sqrt((me - 1) / (n + 1)) - 1) + self.cs
+        self.chiN = math.sqrt(n) * (1 - 1 / (4 * n) + 1 / (21 * n**2))
+
+    def init(self, key: jax.Array) -> SepCMAESState:
+        n = self.dim
+        return SepCMAESState(
+            mean=self.center_init,
+            sigma=jnp.asarray(self.init_stdev, dtype=jnp.float32),
+            pc=jnp.zeros((n,)),
+            ps=jnp.zeros((n,)),
+            C=jnp.ones((n,)),
+            z=jnp.zeros((self.pop_size, n)),
+            iteration=jnp.zeros((), dtype=jnp.int32),
+            key=key,
+        )
+
+    def ask(self, state: SepCMAESState) -> Tuple[jax.Array, SepCMAESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        pop = state.mean + state.sigma * jnp.sqrt(state.C) * z
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: SepCMAESState, fitness: jax.Array) -> SepCMAESState:
+        n = self.dim
+        order = jnp.argsort(fitness)
+        z_sorted = state.z[order][: self.mu]
+        D = jnp.sqrt(state.C)
+        y_sorted = z_sorted * D
+        y_w = self.weights @ y_sorted
+        z_w = self.weights @ z_sorted
+        mean = state.mean + state.sigma * y_w
+        ps = (1 - self.cs) * state.ps + math.sqrt(self.cs * (2 - self.cs) * self.mueff) * z_w
+        it = state.iteration + 1
+        ps_norm = jnp.linalg.norm(ps)
+        hsig = ps_norm / jnp.sqrt(1 - (1 - self.cs) ** (2 * it.astype(jnp.float32))) < (
+            1.4 + 2 / (n + 1)
+        ) * self.chiN
+        hsig = hsig.astype(jnp.float32)
+        pc = (1 - self.cc) * state.pc + hsig * math.sqrt(
+            self.cc * (2 - self.cc) * self.mueff
+        ) * y_w
+        rank_mu = self.weights @ (y_sorted**2)
+        C = (
+            (1 - self.c1 - self.cmu) * state.C
+            + self.c1 * (pc**2 + (1 - hsig) * self.cc * (2 - self.cc) * state.C)
+            + self.cmu * rank_mu
+        )
+        C = jnp.maximum(C, 1e-20)
+        sigma = state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1))
+        return state.replace(mean=mean, sigma=sigma, pc=pc, ps=ps, C=C, iteration=it)
+
+
+class _RestartCMAES(CMAES):
+    """CMA-ES with jit-compatible in-place restart on stagnation: when the
+    best-fitness spread over the current generation collapses below
+    ``stagnation_tol`` (or sigma explodes/vanishes), strategy state resets
+    and the mean re-samples uniformly in ``restart_bounds``. Shapes (and
+    pop size) stay static — see module docstring for why growth is host-side.
+    """
+
+    def __init__(self, *args, stagnation_tol: float = 1e-12,
+                 restart_bounds: Tuple[float, float] = (-1.0, 1.0), **kwargs):
+        super().__init__(*args, **kwargs)
+        self.stagnation_tol = stagnation_tol
+        self.restart_bounds = restart_bounds
+
+    def tell(self, state: CMAESState, fitness: jax.Array) -> CMAESState:
+        new_state = super().tell(state, fitness)
+        spread = jnp.max(fitness) - jnp.min(fitness)
+        degenerate = (
+            (spread < self.stagnation_tol)
+            | (new_state.sigma < 1e-16)
+            | (new_state.sigma > 1e16)
+            | ~jnp.isfinite(new_state.sigma)
+        )
+
+        def restart(s: CMAESState) -> CMAESState:
+            key, k = jax.random.split(s.key)
+            lo, hi = self.restart_bounds
+            mean = jax.random.uniform(k, (self.dim,), minval=lo, maxval=hi)
+            fresh = self.init(key)
+            return fresh.replace(mean=mean, iteration=s.iteration)
+
+        return jax.lax.cond(degenerate, restart, lambda s: s, new_state)
+
+
+class IPOPCMAES(_RestartCMAES):
+    """Restart-CMA-ES (static pop size inside jit; use
+    :class:`RestartCMAESDriver` for true IPOP population doubling)."""
+
+
+class BIPOPCMAES(_RestartCMAES):
+    """Restart-CMA-ES (static pop size inside jit; use
+    :class:`RestartCMAESDriver` with ``bipop=True`` for the two-regime
+    budget schedule)."""
+
+
+class RestartCMAESDriver:
+    """Host-level IPOP/BIPOP driver (Auger & Hansen 2005; Hansen 2009).
+
+    Runs CMA-ES to stagnation, then restarts with a doubled population
+    (IPOP) or alternates large/small-pop regimes (BIPOP). Each pop size is a
+    separate compiled program — the TPU-honest way to grow λ, since XLA
+    shapes are static.
+
+    Usage::
+
+        driver = RestartCMAESDriver(center_init, init_stdev, evaluate_fn)
+        best_x, best_f = driver.run(key, max_restarts=5, gens_per_run=200)
+    """
+
+    def __init__(self, center_init, init_stdev, evaluate_fn, bipop: bool = False,
+                 base_pop_size: Optional[int] = None):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.init_stdev = init_stdev
+        self.evaluate_fn = evaluate_fn
+        self.bipop = bipop
+        self.base_pop_size = base_pop_size or _default_pop_size(self.center_init.shape[0])
+
+    def run(self, key: jax.Array, max_restarts: int = 5, gens_per_run: int = 200):
+        best_x, best_f = None, jnp.inf
+        pop_size = self.base_pop_size
+        for restart in range(max_restarts):
+            key, k_init, k_regime = jax.random.split(key, 3)
+            if self.bipop and restart > 0 and jax.random.bernoulli(k_regime):
+                lam = max(self.base_pop_size // 2, 4)  # small regime
+            else:
+                lam = pop_size
+            algo = CMAES(self.center_init, self.init_stdev, pop_size=lam)
+            state = algo.init(k_init)
+
+            @jax.jit
+            def gen(state):
+                pop, state = algo.ask(state)
+                fit = self.evaluate_fn(pop)
+                state = algo.tell(state, fit)
+                return state, pop, fit
+
+            for _ in range(gens_per_run):
+                state, pop, fit = gen(state)
+                i = jnp.argmin(fit)
+                if fit[i] < best_f:
+                    best_f, best_x = fit[i], pop[i]
+                spread = jnp.max(fit) - jnp.min(fit)
+                if spread < 1e-12 or not jnp.isfinite(state.sigma):
+                    break
+            pop_size *= 2  # IPOP growth for the next large-regime restart
+        return best_x, best_f

@@ -1,0 +1,140 @@
+"""Exponential & Separable Natural Evolution Strategies (Wierstra et al.
+2014, JMLR "Natural Evolution Strategies"; Glasmachers et al. 2010).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/nes.py
+(XNES, SeparableNES).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+
+
+def nes_utilities(pop_size: int) -> jax.Array:
+    """Rank-based fitness-shaping utilities, best-first (NES eq. 15):
+    u_i ∝ max(0, ln(λ/2+1) − ln i), shifted to sum to zero."""
+    ranks = jnp.arange(1, pop_size + 1, dtype=jnp.float32)
+    raw = jnp.maximum(0.0, math.log(pop_size / 2 + 1) - jnp.log(ranks))
+    return raw / jnp.sum(raw) - 1.0 / pop_size
+
+
+class XNESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    B: jax.Array  # normalized shape matrix; full transform A = sigma * B
+    z: jax.Array
+    key: jax.Array
+
+
+class XNES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        lr_mean: float = 1.0,
+        lr_sigma: Optional[float] = None,
+        lr_B: Optional[float] = None,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = d = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = pop_size or (4 + 3 * math.floor(math.log(d)))
+        default_lr = (9 + 3 * math.log(d)) / (5 * d * math.sqrt(d))
+        self.lr_mean = lr_mean
+        self.lr_sigma = default_lr if lr_sigma is None else lr_sigma
+        self.lr_B = default_lr if lr_B is None else lr_B
+        self.utilities = nes_utilities(self.pop_size)
+
+    def init(self, key: jax.Array) -> XNESState:
+        return XNESState(
+            mean=self.center_init,
+            sigma=jnp.asarray(self.init_stdev, dtype=jnp.float32),
+            B=jnp.eye(self.dim),
+            z=jnp.zeros((self.pop_size, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: XNESState) -> Tuple[jax.Array, XNESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        pop = state.mean + state.sigma * (z @ state.B.T)
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: XNESState, fitness: jax.Array) -> XNESState:
+        order = jnp.argsort(fitness)  # ascending: best first (minimization)
+        z = state.z[order]
+        u = self.utilities
+        g_delta = u @ z
+        g_M = (z * u[:, None]).T @ z - jnp.sum(u) * jnp.eye(self.dim)
+        g_sigma = jnp.trace(g_M) / self.dim
+        g_B = g_M - g_sigma * jnp.eye(self.dim)
+        mean = state.mean + self.lr_mean * state.sigma * (state.B @ g_delta)
+        sigma = state.sigma * jnp.exp(self.lr_sigma / 2.0 * g_sigma)
+        B = state.B @ _expm_sym(self.lr_B / 2.0 * g_B)
+        return state.replace(mean=mean, sigma=sigma, B=B)
+
+
+def _expm_sym(M: jax.Array) -> jax.Array:
+    """Matrix exponential of a symmetric matrix via eigendecomposition."""
+    M = (M + M.T) / 2.0
+    w, V = jnp.linalg.eigh(M)
+    return (V * jnp.exp(w)) @ V.T
+
+
+class SeparableNESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array  # per-dimension stdev
+    z: jax.Array
+    key: jax.Array
+
+
+class SeparableNES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        lr_mean: float = 1.0,
+        lr_sigma: Optional[float] = None,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = d = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = pop_size or (4 + 3 * math.floor(math.log(d)))
+        self.lr_mean = lr_mean
+        self.lr_sigma = (
+            (3 + math.log(d)) / (5 * math.sqrt(d)) if lr_sigma is None else lr_sigma
+        )
+        self.utilities = nes_utilities(self.pop_size)
+
+    def init(self, key: jax.Array) -> SeparableNESState:
+        return SeparableNESState(
+            mean=self.center_init,
+            sigma=jnp.full((self.dim,), self.init_stdev, dtype=jnp.float32),
+            z=jnp.zeros((self.pop_size, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: SeparableNESState) -> Tuple[jax.Array, SeparableNESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        pop = state.mean + state.sigma * z
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: SeparableNESState, fitness: jax.Array) -> SeparableNESState:
+        order = jnp.argsort(fitness)
+        z = state.z[order]
+        u = self.utilities
+        g_mean = u @ z
+        g_sigma = u @ (z**2 - 1.0)
+        mean = state.mean + self.lr_mean * state.sigma * g_mean
+        sigma = state.sigma * jnp.exp(self.lr_sigma / 2.0 * g_sigma)
+        return state.replace(mean=mean, sigma=sigma)

@@ -1,0 +1,73 @@
+"""OpenAI Evolution Strategy (Salimans et al. 2017, arXiv:1703.03864).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/open_es.py
+(mirrored sampling, optional optax optimizer), functional TPU-native state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .common import make_optimizer
+
+
+class OpenESState(PyTreeNode):
+    center: jax.Array
+    opt_state: tuple
+    noise: jax.Array
+    key: jax.Array
+
+
+class OpenES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        pop_size: int,
+        learning_rate: float = 0.05,
+        noise_stdev: float = 0.02,
+        optimizer=None,
+        mirrored_sampling: bool = True,
+    ):
+        assert pop_size > 0 and learning_rate > 0 and noise_stdev > 0
+        if mirrored_sampling:
+            assert pop_size % 2 == 0, "mirrored sampling needs an even pop_size"
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = self.center_init.shape[0]
+        self.pop_size = pop_size
+        self.learning_rate = learning_rate
+        self.noise_stdev = noise_stdev
+        self.mirrored = mirrored_sampling
+        self.optimizer = make_optimizer(optimizer, learning_rate)
+
+    def init(self, key: jax.Array) -> OpenESState:
+        return OpenESState(
+            center=self.center_init,
+            opt_state=self.optimizer.init(self.center_init),
+            noise=jnp.zeros((self.pop_size, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: OpenESState) -> Tuple[jax.Array, OpenESState]:
+        key, k = jax.random.split(state.key)
+        if self.mirrored:
+            half = jax.random.normal(k, (self.pop_size // 2, self.dim))
+            noise = jnp.concatenate([half, -half], axis=0)
+        else:
+            noise = jax.random.normal(k, (self.pop_size, self.dim))
+        pop = state.center + self.noise_stdev * noise
+        return pop, state.replace(noise=noise, key=key)
+
+    def tell(self, state: OpenESState, fitness: jax.Array) -> OpenESState:
+        # minimize: estimated gradient of E[f] wrt center
+        grad = state.noise.T @ fitness / (self.pop_size * self.noise_stdev)
+        updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
+        return state.replace(
+            center=optax.apply_updates(state.center, updates),
+            opt_state=opt_state,
+        )

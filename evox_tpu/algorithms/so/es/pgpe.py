@@ -1,0 +1,84 @@
+"""PGPE — Policy Gradients with Parameter-based Exploration (Sehnke et al.
+2010) with the ClipUp optimizer (Toklu et al. 2020, arXiv:2008.02387).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/pgpe.py
+(symmetric +/- sampling, center gradient from paired fitness differences,
+stdev gradient from the baseline-relative term; optimizer = ClipUp, an optax
+name, or an optax transformation).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from ....utils.optimizers import clipup, make_optimizer
+
+# Alias matching the reference's ClipUp class name (pgpe.py:34-64)
+ClipUp = clipup
+
+
+class PGPEState(PyTreeNode):
+    center: jax.Array
+    stdev: jax.Array
+    opt_state: tuple
+    delta: jax.Array
+    key: jax.Array
+
+
+class PGPE(Algorithm):
+    def __init__(
+        self,
+        pop_size: int,
+        center_init,
+        optimizer: Union[str, optax.GradientTransformation, None] = "clipup",
+        stdev_init: float = 0.1,
+        center_learning_rate: float = 0.15,
+        stdev_learning_rate: float = 0.1,
+        stdev_max_change: float = 0.2,
+    ):
+        assert pop_size % 2 == 0, "PGPE uses symmetric sampling; pop_size must be even"
+        self.pop_size = pop_size
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = self.center_init.shape[0]
+        self.stdev_init = stdev_init
+        self.stdev_lr = stdev_learning_rate
+        self.stdev_max_change = stdev_max_change
+        self.optimizer = make_optimizer(optimizer, center_learning_rate)
+
+    def init(self, key: jax.Array) -> PGPEState:
+        return PGPEState(
+            center=self.center_init,
+            stdev=jnp.full((self.dim,), self.stdev_init, dtype=jnp.float32),
+            opt_state=self.optimizer.init(self.center_init),
+            delta=jnp.zeros((self.pop_size // 2, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: PGPEState) -> Tuple[jax.Array, PGPEState]:
+        key, k = jax.random.split(state.key)
+        delta = jax.random.normal(k, (self.pop_size // 2, self.dim)) * state.stdev
+        pop = jnp.concatenate([state.center + delta, state.center - delta], axis=0)
+        return pop, state.replace(delta=delta, key=key)
+
+    def tell(self, state: PGPEState, fitness: jax.Array) -> PGPEState:
+        half = self.pop_size // 2
+        f_pos, f_neg = fitness[:half], fitness[half:]
+        # minimization: descend the fitness landscape
+        center_grad = ((f_pos - f_neg) / 2.0) @ state.delta / half
+        updates, opt_state = self.optimizer.update(center_grad, state.opt_state, state.center)
+        center = optax.apply_updates(state.center, updates)
+
+        baseline = jnp.mean(fitness)
+        s = (state.delta**2 - state.stdev**2) / state.stdev
+        stdev_grad = ((f_pos + f_neg) / 2.0 - baseline) @ s / half
+        # bounded multiplicative update (reference pgpe.py:118-133 behavior)
+        allowed = self.stdev_max_change * state.stdev
+        stdev = state.stdev - jnp.clip(self.stdev_lr * stdev_grad, -allowed, allowed)
+        stdev = jnp.maximum(stdev, 1e-8)
+        return state.replace(center=center, stdev=stdev, opt_state=opt_state)

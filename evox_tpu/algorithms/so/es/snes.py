@@ -1,0 +1,75 @@
+"""SNES — Separable Natural Evolution Strategy (Schaul et al. 2011).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/snes.py.
+Same update family as :class:`SeparableNES` but with the reference's
+configurable temperature-weighted recombination option.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .nes import nes_utilities
+
+
+class SNESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    z: jax.Array
+    key: jax.Array
+
+
+class SNES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        weight_type: str = "recomb",  # "recomb" | "temp"
+        temperature: float = 12.5,
+        lr_mean: float = 1.0,
+        lr_sigma: Optional[float] = None,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = d = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = lam = pop_size or (4 + 3 * math.floor(math.log(d)))
+        self.lr_mean = lr_mean
+        self.lr_sigma = (
+            (3 + math.log(d)) / (5 * math.sqrt(d)) if lr_sigma is None else lr_sigma
+        )
+        if weight_type == "recomb":
+            self.weights = nes_utilities(lam)
+        elif weight_type == "temp":
+            ranks = jnp.arange(lam, dtype=jnp.float32) / (lam - 1) - 0.5
+            w = jax.nn.softmax(-ranks * temperature)  # best (rank 0) heaviest
+            self.weights = w - 1.0 / lam
+        else:
+            raise ValueError(f"unknown weight_type {weight_type!r}")
+
+    def init(self, key: jax.Array) -> SNESState:
+        return SNESState(
+            mean=self.center_init,
+            sigma=jnp.full((self.dim,), self.init_stdev, dtype=jnp.float32),
+            z=jnp.zeros((self.pop_size, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: SNESState) -> Tuple[jax.Array, SNESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        pop = state.mean + state.sigma * z
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: SNESState, fitness: jax.Array) -> SNESState:
+        z = state.z[jnp.argsort(fitness)]
+        w = self.weights
+        mean = state.mean + self.lr_mean * state.sigma * (w @ z)
+        sigma = state.sigma * jnp.exp(self.lr_sigma / 2.0 * (w @ (z**2 - 1.0)))
+        return state.replace(mean=mean, sigma=sigma)

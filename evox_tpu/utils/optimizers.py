@@ -50,13 +50,15 @@ def clipup(
 
 
 def make_optimizer(
-    optimizer: Union[str, optax.GradientTransformation],
+    optimizer: Union[str, optax.GradientTransformation, None],
     learning_rate: float = 0.01,
     **kwargs,
 ) -> optax.GradientTransformation:
     """Resolve a name ('adam', 'sgd', 'clipup', …) or pass through an optax
     transformation. Note: ES algorithms *minimize*, and gradients passed in
     are descent directions, so plain optax semantics apply."""
+    if optimizer is None:
+        return optax.sgd(learning_rate)
     if isinstance(optimizer, optax.GradientTransformation):
         return optimizer
     if optimizer == "clipup":

@@ -105,7 +105,12 @@ class StdWorkflow:
                 "post_step",
             )
         }
+        self.jit_step = jit_step
         self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
+        # dynamic trip count: ONE compile covers every n_steps
+        self._run_loop = jax.jit(
+            lambda s, n: jax.lax.fori_loop(0, n, lambda _, x: self._step_impl(x), s)
+        )
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> StdWorkflowState:
@@ -121,6 +126,32 @@ class StdWorkflow:
     # ------------------------------------------------------------------ step
     def step(self, state: StdWorkflowState) -> StdWorkflowState:
         return self._step(state)
+
+    def run(self, state: StdWorkflowState, n_steps: int) -> StdWorkflowState:
+        """Run ``n_steps`` generations as ONE compiled program.
+
+        TPU-first: a Python ``for`` loop over ``step`` pays a host dispatch
+        per generation; ``run`` fuses generations into a single on-device
+        ``lax.fori_loop`` (the reference has no analog — its per-step host
+        loop is the cost its Ray pipelining tries to hide). The trip count is
+        a traced operand, so one compilation covers every ``n_steps``. The
+        first generation is peeled off eagerly (``first_step`` is static so
+        the loop carry stays type-stable across the init_ask/init_tell
+        dispatch). With ``jit_step=False`` this falls back to an eager
+        Python loop for debugging.
+        """
+        if n_steps <= 0:
+            return state
+        if state.first_step:
+            state = self.step(state)
+            n_steps -= 1
+        if not self.jit_step:
+            for _ in range(n_steps):
+                state = self._step_impl(state)
+            return state
+        if n_steps > 0:
+            state = self._run_loop(state, jnp.asarray(n_steps, dtype=jnp.int32))
+        return state
 
     def _run_hooks(self, name: str, mstates: list, *args: Any) -> None:
         for i in self._hook_table[name]:

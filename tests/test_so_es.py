@@ -1,0 +1,83 @@
+"""Convergence tests for the ES family on Sphere, mirroring the reference's
+test strategy (tests/test_single_objective_algorithms.py: run N generations
+through the full workflow, assert best fitness below a threshold)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu import StdWorkflow
+from evox_tpu.algorithms import (
+    ARS,
+    CMAES,
+    OpenES,
+    PGPE,
+    SNES,
+    SepCMAES,
+    SeparableNES,
+    XNES,
+)
+from evox_tpu.monitors import EvalMonitor
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.utils import rank_based_fitness
+
+DIM = 5
+
+
+def run_algorithm(algo, steps, fit_transforms=(), seed=17):
+    monitor = EvalMonitor()
+    wf = StdWorkflow(algo, Sphere(), monitors=(monitor,), fit_transforms=fit_transforms)
+    state = wf.init(jax.random.PRNGKey(seed))
+    state = wf.run(state, steps)
+    return float(monitor.get_best_fitness(state.monitors[0]))
+
+
+def test_openes():
+    algo = OpenES(
+        center_init=jnp.full((DIM,), 5.0),
+        pop_size=100,
+        learning_rate=0.05,
+        noise_stdev=0.2,
+        optimizer="adam",
+    )
+    assert run_algorithm(algo, 500, fit_transforms=(rank_based_fitness,)) < 1.0
+
+
+def test_pgpe_clipup():
+    algo = PGPE(100, center_init=jnp.full((DIM,), 5.0), optimizer="clipup")
+    assert run_algorithm(algo, 300, fit_transforms=(rank_based_fitness,)) < 0.1
+
+
+def test_pgpe_adam():
+    algo = PGPE(100, center_init=jnp.full((DIM,), 5.0), optimizer="adam")
+    assert run_algorithm(algo, 300, fit_transforms=(rank_based_fitness,)) < 0.1
+
+
+def test_cmaes():
+    algo = CMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16)
+    assert run_algorithm(algo, 200) < 0.01
+
+
+def test_sep_cmaes():
+    algo = SepCMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_xnes():
+    algo = XNES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16)
+    assert run_algorithm(algo, 200) < 0.01
+
+
+def test_separable_nes():
+    algo = SeparableNES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_snes():
+    algo = SNES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_ars():
+    algo = ARS(center_init=jnp.full((DIM,), 3.0), pop_size=64, learning_rate=0.1)
+    assert run_algorithm(algo, 300) < 0.5
