@@ -1,32 +1,6 @@
 from . import so
 from .so.pso import PSO, CSO
-from .so.es import (
-    OpenES,
-    PGPE,
-    CMAES,
-    SepCMAES,
-    IPOPCMAES,
-    BIPOPCMAES,
-    RestartCMAESDriver,
-    XNES,
-    SeparableNES,
-    SNES,
-    ARS,
-)
+from .so.es import *  # noqa: F401,F403 — full ES surface
+from .so import es as _es
 
-__all__ = [
-    "so",
-    "PSO",
-    "CSO",
-    "OpenES",
-    "PGPE",
-    "CMAES",
-    "SepCMAES",
-    "IPOPCMAES",
-    "BIPOPCMAES",
-    "RestartCMAESDriver",
-    "XNES",
-    "SeparableNES",
-    "SNES",
-    "ARS",
-]
+__all__ = ["so", "PSO", "CSO"] + list(_es.__all__)

@@ -1,0 +1,98 @@
+"""CoDE — Composite Differential Evolution (Wang, Cai & Zhang 2011).
+
+Capability parity with reference src/evox/algorithms/so/de_variants/code.py.
+Each parent generates three trials — one per strategy (rand/1/bin,
+rand/2/bin, current-to-rand/1) — each with control parameters drawn from the
+paper's pool; the workflow evaluates all ``3 * pop_size`` candidates and
+``tell`` keeps the best trial per parent, then selects greedily vs the
+parent.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .de import select_rand_indices
+
+# [F, CR] parameter pool (Wang et al. 2011, §III)
+_PARAM_POOL = jnp.asarray([[1.0, 0.1], [1.0, 0.9], [0.8, 0.2]], dtype=jnp.float32)
+
+
+class CoDEState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    trials: jax.Array  # (3*pop, dim)
+    key: jax.Array
+
+
+class CoDE(Algorithm):
+    def __init__(self, lb, ub, pop_size: int):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.pop_size = pop_size
+
+    def init(self, key: jax.Array) -> CoDEState:
+        key, k = jax.random.split(key)
+        pop = (
+            jax.random.uniform(k, (self.pop_size, self.dim)) * (self.ub - self.lb)
+            + self.lb
+        )
+        return CoDEState(
+            population=pop,
+            fitness=jnp.full((self.pop_size,), jnp.inf),
+            trials=jnp.tile(pop, (3, 1)),
+            key=key,
+        )
+
+    def init_ask(self, state: CoDEState) -> Tuple[jax.Array, CoDEState]:
+        return state.population, state
+
+    def init_tell(self, state: CoDEState, fitness: jax.Array) -> CoDEState:
+        return state.replace(fitness=fitness)
+
+    def ask(self, state: CoDEState) -> Tuple[jax.Array, CoDEState]:
+        key, k_idx, k_par, k_cr, k_j, k_rec = jax.random.split(state.key, 6)
+        pop = state.population
+        n = self.pop_size
+        idx = select_rand_indices(k_idx, n, 5)
+        r1, r2, r3, r4, r5 = (idx[:, i] for i in range(5))
+        # per-parent per-strategy random parameter-pool rows
+        pool_rows = jax.random.randint(k_par, (3, n), 0, _PARAM_POOL.shape[0])
+        F = _PARAM_POOL[pool_rows, 0][:, :, None]
+        CR = _PARAM_POOL[pool_rows, 1][:, :, None]
+
+        v1 = pop[r1] + F[0] * (pop[r2] - pop[r3])  # rand/1
+        v2 = pop[r1] + F[1] * (pop[r2] - pop[r3]) + F[1] * (pop[r4] - pop[r5])  # rand/2
+        rand_rec = jax.random.uniform(k_rec, (n, 1))
+        v3 = pop + rand_rec * (pop[r1] - pop) + F[2] * (pop[r2] - pop[r3])  # cur-to-rand
+
+        r = jax.random.uniform(k_cr, (2, n, self.dim))
+        j_rand = jax.random.randint(k_j, (2, n, 1), 0, self.dim)
+        mask1 = (r[0] < CR[0]) | (jnp.arange(self.dim) == j_rand[0])
+        mask2 = (r[1] < CR[1]) | (jnp.arange(self.dim) == j_rand[1])
+        t1 = jnp.where(mask1, v1, pop)
+        t2 = jnp.where(mask2, v2, pop)
+        t3 = v3  # current-to-rand/1 uses no crossover
+        trials = jnp.clip(jnp.concatenate([t1, t2, t3], axis=0), self.lb, self.ub)
+        return trials, state.replace(trials=trials, key=key)
+
+    def tell(self, state: CoDEState, fitness: jax.Array) -> CoDEState:
+        n = self.pop_size
+        trial_fit = fitness.reshape(3, n)
+        best_strat = jnp.argmin(trial_fit, axis=0)  # (n,)
+        best_fit = jnp.min(trial_fit, axis=0)
+        trials = state.trials.reshape(3, n, self.dim)
+        best_trial = jnp.take_along_axis(
+            trials, best_strat[None, :, None], axis=0
+        ).squeeze(0)
+        improved = best_fit < state.fitness
+        return state.replace(
+            population=jnp.where(improved[:, None], best_trial, state.population),
+            fitness=jnp.where(improved, best_fit, state.fitness),
+        )

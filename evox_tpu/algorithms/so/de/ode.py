@@ -1,0 +1,41 @@
+"""ODE — Opposition-based Differential Evolution (Rahnamayan et al. 2008).
+
+Capability parity with reference src/evox/algorithms/so/de_variants/ode.py.
+DE plus opposition-based generation jumping: with probability ``jumping_rate``
+a generation proposes the opposition population (dynamic bounds) instead of
+DE trials, keeping the better of each individual/opposite pair.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.struct import PyTreeNode
+from .de import DE, DEState
+
+
+class ODEState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    trials: jax.Array
+    key: jax.Array
+
+
+class ODE(DE):
+    def __init__(self, *args, jumping_rate: float = 0.3, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.jumping_rate = jumping_rate
+
+    def ask(self, state: DEState) -> Tuple[jax.Array, DEState]:
+        key, k_jump, k_mut = jax.random.split(state.key, 3)
+        jump = jax.random.uniform(k_jump) < self.jumping_rate
+        pop = state.population
+        # opposition w.r.t. the population's dynamic bounds
+        lo = jnp.min(pop, axis=0)
+        hi = jnp.max(pop, axis=0)
+        opposite = lo + hi - pop
+        trials = jnp.where(jump, opposite, self._mutate(k_mut, state))
+        return trials, state.replace(trials=trials, key=key)

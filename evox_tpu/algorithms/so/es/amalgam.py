@@ -1,0 +1,132 @@
+"""AMaLGaM — Adapted Maximum-Likelihood Gaussian Model IDEA (Bosman et al.
+2013, "Benchmarking Parameter-Free AMaLGaM on Functions With and Without
+Noise"), full-covariance and independent (diagonal) variants.
+
+Capability parity with reference src/evox/algorithms/so/es_variants/amalgam.py.
+A Gaussian estimation-of-distribution algorithm: fit a Gaussian to the
+selected elite, apply the Anticipated Mean Shift (AMS) to part of the new
+sample, and adapt a distribution multiplier via the Standard-Deviation Ratio
+(SDR) rule.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+
+
+class AMaLGaMState(PyTreeNode):
+    mean: jax.Array
+    C: jax.Array  # covariance (full) or variance vector (independent)
+    mean_shift: jax.Array
+    c_mult: jax.Array
+    best_fitness: jax.Array
+    no_improvement: jax.Array
+    population: jax.Array
+    key: jax.Array
+
+
+class _AMaLGaMBase(Algorithm):
+    full_cov: bool = True
+
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float = 1.0,
+        pop_size: Optional[int] = None,
+        tau: float = 0.35,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = n = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        if pop_size is None:
+            pop_size = int(17 + 3 * n ** 1.5) if self.full_cov else int(10 * math.sqrt(n))
+            pop_size = max(pop_size, 16)
+        self.pop_size = pop_size
+        self.n_elite = max(2, int(tau * pop_size))
+        self.n_ams = max(1, int(0.5 * tau * pop_size))
+        # parameter-free learning rates (Bosman 2013, §parameter settings)
+        self.eta_shift = 0.1
+        self.eta_dec = 0.9
+        self.theta_sdr = 1.0
+
+    def init(self, key: jax.Array) -> AMaLGaMState:
+        n = self.dim
+        C = jnp.eye(n) * self.init_stdev**2 if self.full_cov else jnp.full((n,), self.init_stdev**2)
+        return AMaLGaMState(
+            mean=self.center_init,
+            C=C,
+            mean_shift=jnp.zeros((n,)),
+            c_mult=jnp.ones(()),
+            best_fitness=jnp.asarray(jnp.inf),
+            no_improvement=jnp.zeros((), dtype=jnp.int32),
+            population=jnp.zeros((self.pop_size, n)),
+            key=key,
+        )
+
+    def _sample(self, key: jax.Array, state: AMaLGaMState) -> jax.Array:
+        z = jax.random.normal(key, (self.pop_size, self.dim))
+        if self.full_cov:
+            # sample via Cholesky of the (regularized) covariance
+            L = jnp.linalg.cholesky(state.C + 1e-10 * jnp.eye(self.dim))
+            step = z @ L.T
+        else:
+            step = z * jnp.sqrt(jnp.maximum(state.C, 1e-20))
+        pop = state.mean + jnp.sqrt(state.c_mult) * step
+        # anticipated mean shift on the first n_ams samples (not the elite)
+        ams = pop[: self.n_ams] + 2.0 * state.c_mult * state.mean_shift
+        return jnp.concatenate([ams, pop[self.n_ams :]], axis=0)
+
+    def ask(self, state: AMaLGaMState) -> Tuple[jax.Array, AMaLGaMState]:
+        key, k = jax.random.split(state.key)
+        pop = self._sample(k, state)
+        return pop, state.replace(population=pop, key=key)
+
+    def tell(self, state: AMaLGaMState, fitness: jax.Array) -> AMaLGaMState:
+        order = jnp.argsort(fitness)
+        elite = state.population[order][: self.n_elite]
+        mean = jnp.mean(elite, axis=0)
+        centered = elite - mean
+        if self.full_cov:
+            C_hat = centered.T @ centered / self.n_elite
+            C = (1 - self.eta_shift) * state.C + self.eta_shift * C_hat
+        else:
+            C_hat = jnp.mean(centered**2, axis=0)
+            C = (1 - self.eta_shift) * state.C + self.eta_shift * C_hat
+        mean_shift = (
+            (1 - self.eta_shift) * state.mean_shift + self.eta_shift * (mean - state.mean)
+        )
+
+        # SDR-style multiplier adaptation: grow on improvement found beyond
+        # one standard deviation, decay on stagnation
+        best = fitness[order][0]
+        improved = best < state.best_fitness
+        c_mult = jnp.where(
+            improved,
+            jnp.maximum(state.c_mult, 1.0),
+            state.c_mult * self.eta_dec,
+        )
+        no_improvement = jnp.where(improved, 0, state.no_improvement + 1)
+        c_mult = jnp.where(no_improvement > 25, jnp.ones(()), c_mult)  # restart pressure
+        return state.replace(
+            mean=mean,
+            C=C,
+            mean_shift=mean_shift,
+            c_mult=jnp.maximum(c_mult, 1e-10),
+            best_fitness=jnp.minimum(best, state.best_fitness),
+            no_improvement=no_improvement,
+        )
+
+
+class AMaLGaM(_AMaLGaMBase):
+    full_cov = True
+
+
+class IndependentAMaLGaM(_AMaLGaMBase):
+    full_cov = False

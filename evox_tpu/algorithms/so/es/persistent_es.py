@@ -1,0 +1,155 @@
+"""Persistent ES and Noise-Reuse ES.
+
+- PersistentES: Vicol, Metz & Sohl-Dickstein 2021, "Unbiased Gradient
+  Estimation in Unrolled Computation Graphs with Persistent Evolution
+  Strategies" (PMLR v139). Antithetic ES for truncated unrolls that
+  accumulates perturbations across truncation windows so the gradient
+  estimate stays unbiased across the full unroll.
+- NoiseReuseES: Li et al. 2023, "Noise-Reuse in Online Evolution
+  Strategies" (arXiv:2304.12180): the same machinery but re-applies one
+  frozen noise draw for a whole unroll, resampling at truncation
+  boundaries.
+
+Capability parity with reference src/evox/algorithms/so/es_variants/
+{persistent_es.py, noise_reuse_es.py}.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .common import make_optimizer
+
+
+class PersistentESState(PyTreeNode):
+    center: jax.Array
+    pert_accum: jax.Array  # (n_pairs, dim) accumulated perturbations
+    opt_state: tuple
+    noise: jax.Array
+    inner_step: jax.Array
+    key: jax.Array
+
+
+class PersistentES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        pop_size: int,
+        truncation_length: int = 100,
+        learning_rate: float = 0.05,
+        noise_stdev: float = 0.1,
+        optimizer=None,
+    ):
+        assert pop_size % 2 == 0
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.pop_size = pop_size
+        self.n_pairs = pop_size // 2
+        self.T = truncation_length
+        self.noise_stdev = noise_stdev
+        self.optimizer = make_optimizer(optimizer, learning_rate)
+
+    def init(self, key: jax.Array) -> PersistentESState:
+        return PersistentESState(
+            center=self.center_init,
+            pert_accum=jnp.zeros((self.n_pairs, self.dim)),
+            opt_state=self.optimizer.init(self.center_init),
+            noise=jnp.zeros((self.n_pairs, self.dim)),
+            inner_step=jnp.zeros((), dtype=jnp.int32),
+            key=key,
+        )
+
+    def ask(self, state: PersistentESState) -> Tuple[jax.Array, PersistentESState]:
+        key, k = jax.random.split(state.key)
+        noise = jax.random.normal(k, (self.n_pairs, self.dim))
+        pop = jnp.concatenate(
+            [state.center + self.noise_stdev * noise,
+             state.center - self.noise_stdev * noise],
+            axis=0,
+        )
+        return pop, state.replace(noise=noise, key=key)
+
+    def tell(self, state: PersistentESState, fitness: jax.Array) -> PersistentESState:
+        pert_accum = state.pert_accum + self.noise_stdev * state.noise
+        f_pos, f_neg = fitness[: self.n_pairs], fitness[self.n_pairs :]
+        # PES: correlate pair differences with the *accumulated* perturbation
+        grad = ((f_pos - f_neg) / 2.0) @ pert_accum / (
+            self.n_pairs * self.noise_stdev**2
+        )
+        updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
+        inner = state.inner_step + 1
+        reset = inner >= self.T
+        return state.replace(
+            center=optax.apply_updates(state.center, updates),
+            pert_accum=jnp.where(reset, jnp.zeros_like(pert_accum), pert_accum),
+            opt_state=opt_state,
+            inner_step=jnp.where(reset, 0, inner),
+        )
+
+
+class NoiseReuseESState(PyTreeNode):
+    center: jax.Array
+    noise: jax.Array
+    opt_state: tuple
+    inner_step: jax.Array
+    key: jax.Array
+
+
+class NoiseReuseES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        pop_size: int,
+        truncation_length: int = 100,
+        learning_rate: float = 0.05,
+        noise_stdev: float = 0.1,
+        optimizer=None,
+    ):
+        assert pop_size % 2 == 0
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = int(self.center_init.shape[0])
+        self.pop_size = pop_size
+        self.n_pairs = pop_size // 2
+        self.T = truncation_length
+        self.noise_stdev = noise_stdev
+        self.optimizer = make_optimizer(optimizer, learning_rate)
+
+    def init(self, key: jax.Array) -> NoiseReuseESState:
+        return NoiseReuseESState(
+            center=self.center_init,
+            noise=jnp.zeros((self.n_pairs, self.dim)),
+            opt_state=self.optimizer.init(self.center_init),
+            inner_step=jnp.zeros((), dtype=jnp.int32),
+            key=key,
+        )
+
+    def ask(self, state: NoiseReuseESState) -> Tuple[jax.Array, NoiseReuseESState]:
+        key, k = jax.random.split(state.key)
+        fresh = jax.random.normal(k, (self.n_pairs, self.dim))
+        # reuse the frozen draw within a truncation window
+        noise = jnp.where(state.inner_step == 0, fresh, state.noise)
+        pop = jnp.concatenate(
+            [state.center + self.noise_stdev * noise,
+             state.center - self.noise_stdev * noise],
+            axis=0,
+        )
+        return pop, state.replace(noise=noise, key=key)
+
+    def tell(self, state: NoiseReuseESState, fitness: jax.Array) -> NoiseReuseESState:
+        f_pos, f_neg = fitness[: self.n_pairs], fitness[self.n_pairs :]
+        grad = ((f_pos - f_neg) / 2.0) @ state.noise / (
+            self.n_pairs * self.noise_stdev
+        )
+        updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
+        inner = state.inner_step + 1
+        return state.replace(
+            center=optax.apply_updates(state.center, updates),
+            opt_state=opt_state,
+            inner_step=jnp.where(inner >= self.T, 0, inner),
+        )

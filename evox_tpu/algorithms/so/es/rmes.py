@@ -1,0 +1,120 @@
+"""RM-ES — Rank-m Evolution Strategy (Li & Zhang 2018, IEEE TEVC, "A Simple
+Yet Efficient Evolution Strategy for Large-Scale Black-Box Optimization").
+
+Capability parity with reference src/evox/algorithms/so/es_variants/rmes.py.
+Maintains m evolution-path vectors as a low-rank covariance model (O(m·d)
+memory) plus population-success-rule step-size adaptation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .cma_es import _default_pop_size
+
+
+class RMESState(PyTreeNode):
+    mean: jax.Array
+    sigma: jax.Array
+    pc: jax.Array
+    P: jax.Array  # (m, dim) stored evolution paths
+    p_iters: jax.Array  # (m,) generation each path was stored
+    prev_fitness: jax.Array
+    s: jax.Array  # smoothed success measure
+    iteration: jax.Array
+    z: jax.Array
+    key: jax.Array
+
+
+class RMES(Algorithm):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        memory_size: int = 2,
+    ):
+        self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
+        self.dim = n = int(self.center_init.shape[0])
+        self.init_stdev = float(init_stdev)
+        self.pop_size = lam = pop_size or _default_pop_size(n)
+        self.m = memory_size
+        mu = lam // 2
+        w = math.log(mu + 0.5) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
+        w = w / jnp.sum(w)
+        self.mu, self.weights = mu, w
+        me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
+        self.mueff = me
+        self.ccov = 1.0 / (3 * math.sqrt(n) + 5)  # rank-one mixing weight
+        self.cc = 2.0 / (n + 7)
+        self.c_sigma = 0.3
+        self.q_star = 0.3
+        self.d_sigma = 1.0
+        self.T = n  # minimum generation gap between stored paths
+
+    def init(self, key: jax.Array) -> RMESState:
+        n = self.dim
+        return RMESState(
+            mean=self.center_init,
+            sigma=jnp.asarray(self.init_stdev, dtype=jnp.float32),
+            pc=jnp.zeros((n,)),
+            P=jnp.zeros((self.m, n)),
+            p_iters=jnp.zeros((self.m,), dtype=jnp.int32),
+            prev_fitness=jnp.full((self.mu,), jnp.inf),
+            s=jnp.zeros(()),
+            iteration=jnp.zeros((), dtype=jnp.int32),
+            z=jnp.zeros((self.pop_size, n)),
+            key=key,
+        )
+
+    def ask(self, state: RMESState) -> Tuple[jax.Array, RMESState]:
+        key, kz, kr = jax.random.split(state.key, 3)
+        z = jax.random.normal(kz, (self.pop_size, self.dim))
+        r = jax.random.normal(kr, (self.pop_size, self.m))
+        # y = sqrt(1-ccov)^m z + sum_i sqrt(ccov (1-ccov)^(m-i)) r_i P_i
+        a = math.sqrt(1 - self.ccov)
+        y = (a**self.m) * z
+        for i in range(self.m):
+            coef = math.sqrt(self.ccov) * (a ** (self.m - 1 - i))
+            y = y + coef * r[:, i : i + 1] * state.P[i]
+        pop = state.mean + state.sigma * y
+        return pop, state.replace(z=y, key=key)  # store the composed direction
+
+    def tell(self, state: RMESState, fitness: jax.Array) -> RMESState:
+        order = jnp.argsort(fitness)
+        y_sel = state.z[order][: self.mu]
+        y_w = self.weights @ y_sel
+        mean = state.mean + state.sigma * y_w
+        pc = (1 - self.cc) * state.pc + math.sqrt(
+            self.cc * (2 - self.cc) * self.mueff
+        ) * y_w
+
+        it = state.iteration + 1
+        # path archive update: replace the oldest when the generation gap of
+        # the newest stored pair is large enough, else replace the newest
+        gap_ok = (it - state.p_iters[-1]) > self.T if self.m > 1 else jnp.array(True)
+        shifted_P = jnp.concatenate([state.P[1:], pc[None, :]], axis=0)
+        shifted_it = jnp.concatenate([state.p_iters[1:], it[None]], axis=0)
+        replaced_P = state.P.at[-1].set(pc)
+        replaced_it = state.p_iters.at[-1].set(it)
+        P = jnp.where(gap_ok, shifted_P, replaced_P)
+        p_iters = jnp.where(gap_ok, shifted_it, replaced_it)
+
+        # population success rule (PSR) step-size adaptation
+        f_sel = fitness[order][: self.mu]
+        merged = jnp.concatenate([f_sel, state.prev_fitness])
+        ranks = jnp.argsort(jnp.argsort(merged)).astype(jnp.float32)
+        q = (jnp.mean(ranks[self.mu :]) - jnp.mean(ranks[: self.mu])) / self.mu
+        s = (1 - self.c_sigma) * state.s + self.c_sigma * (q - self.q_star)
+        sigma = state.sigma * jnp.exp(s / self.d_sigma)
+
+        return state.replace(
+            mean=mean, sigma=sigma, pc=pc, P=P, p_iters=p_iters,
+            prev_fitness=f_sel, s=s, iteration=it,
+        )

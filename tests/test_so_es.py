@@ -81,3 +81,95 @@ def test_snes():
 def test_ars():
     algo = ARS(center_init=jnp.full((DIM,), 3.0), pop_size=64, learning_rate=0.1)
     assert run_algorithm(algo, 300) < 0.5
+
+
+# ---- long tail -------------------------------------------------------------
+
+from evox_tpu.algorithms.so.es import (
+    AMaLGaM,
+    ASEBO,
+    CR_FM_NES,
+    DES,
+    ESMC,
+    GuidedES,
+    IndependentAMaLGaM,
+    LMMAES,
+    MAES,
+    NoiseReuseES,
+    PersistentES,
+    RMES,
+    LES,
+)
+
+
+def test_maes():
+    algo = MAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16)
+    assert run_algorithm(algo, 200) < 0.01
+
+
+def test_lmmaes():
+    algo = LMMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_rmes():
+    algo = RMES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 400) < 0.1
+
+
+def test_amalgam():
+    algo = AMaLGaM(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=64)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_independent_amalgam():
+    algo = IndependentAMaLGaM(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=64)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_des():
+    algo = DES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_esmc():
+    algo = ESMC(center_init=jnp.full((DIM,), 3.0), pop_size=101, learning_rate=0.5,
+                noise_stdev=0.2, optimizer="adam")
+    assert run_algorithm(algo, 400) < 1.0
+
+
+def test_guided_es():
+    algo = GuidedES(center_init=jnp.full((DIM,), 3.0), pop_size=64, subspace_dims=2,
+                    learning_rate=0.5, noise_stdev=0.2, optimizer="adam")
+    assert run_algorithm(algo, 400) < 1.0
+
+
+def test_persistent_es():
+    algo = PersistentES(center_init=jnp.full((DIM,), 3.0), pop_size=64,
+                        truncation_length=10, learning_rate=0.3, noise_stdev=0.2,
+                        optimizer="adam")
+    assert run_algorithm(algo, 400) < 1.0
+
+
+def test_noise_reuse_es():
+    algo = NoiseReuseES(center_init=jnp.full((DIM,), 3.0), pop_size=64,
+                        truncation_length=10, learning_rate=0.3, noise_stdev=0.2,
+                        optimizer="adam")
+    assert run_algorithm(algo, 400) < 1.0
+
+
+def test_asebo():
+    algo = ASEBO(center_init=jnp.full((DIM,), 3.0), pop_size=64, subspace_dims=3,
+                 learning_rate=0.5, noise_stdev=0.2, optimizer="adam")
+    assert run_algorithm(algo, 400) < 1.0
+
+
+def test_cr_fm_nes():
+    algo = CR_FM_NES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 300) < 0.1
+
+
+def test_les_runs():
+    # un-meta-trained params: smoke + monotone-ish progress, not convergence
+    algo = LES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32)
+    assert run_algorithm(algo, 100) < run_algorithm(algo, 1) * 10
