@@ -1,6 +1,7 @@
 from . import so
 from .so.pso import PSO, CSO
 from .so.es import *  # noqa: F401,F403 — full ES surface
-from .so import es as _es
+from .so.de import *  # noqa: F401,F403 — full DE surface
+from .so import es as _es, de as _de
 
-__all__ = ["so", "PSO", "CSO"] + list(_es.__all__)
+__all__ = ["so", "PSO", "CSO"] + list(_es.__all__) + list(_de.__all__)

@@ -1,5 +1,6 @@
 from .pso import *  # noqa: F401,F403
 from .es import *  # noqa: F401,F403
-from . import pso, es
+from .de import *  # noqa: F401,F403
+from . import pso, es, de
 
-__all__ = ["pso", "es"]
+__all__ = ["pso", "es", "de"]

@@ -1,0 +1,8 @@
+from .de import DE
+from .ode import ODE
+from .code import CoDE
+from .jade import JaDE
+from .sade import SaDE
+from .shade import SHADE
+
+__all__ = ["DE", "ODE", "CoDE", "JaDE", "SaDE", "SHADE"]

@@ -1,0 +1,138 @@
+"""JaDE — Adaptive Differential Evolution (Zhang & Sanderson 2009,
+"JADE: Adaptive Differential Evolution With Optional External Archive").
+
+Capability parity with reference src/evox/algorithms/so/de_variants/jade.py.
+current-to-pbest/1 mutation with an external archive of replaced parents;
+per-individual F ~ Cauchy(mu_F, 0.1) and CR ~ N(mu_CR, 0.1) adapted from the
+successful values each generation (Lehmer / arithmetic means).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .de import select_rand_indices
+
+
+class JaDEState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    trials: jax.Array
+    F: jax.Array  # per-individual, current generation
+    CR: jax.Array
+    mu_F: jax.Array
+    mu_CR: jax.Array
+    archive: jax.Array  # (pop, dim) replaced parents
+    archive_size: jax.Array
+    key: jax.Array
+
+
+class JaDE(Algorithm):
+    def __init__(
+        self,
+        lb,
+        ub,
+        pop_size: int,
+        p_best: float = 0.05,
+        c: float = 0.1,
+        use_archive: bool = True,
+    ):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.pop_size = pop_size
+        self.p_num = max(1, int(p_best * pop_size))
+        self.c = c
+        self.use_archive = use_archive
+
+    def init(self, key: jax.Array) -> JaDEState:
+        key, k = jax.random.split(key)
+        pop = (
+            jax.random.uniform(k, (self.pop_size, self.dim)) * (self.ub - self.lb)
+            + self.lb
+        )
+        return JaDEState(
+            population=pop,
+            fitness=jnp.full((self.pop_size,), jnp.inf),
+            trials=pop,
+            F=jnp.full((self.pop_size,), 0.5),
+            CR=jnp.full((self.pop_size,), 0.5),
+            mu_F=jnp.asarray(0.5),
+            mu_CR=jnp.asarray(0.5),
+            archive=pop,
+            archive_size=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+
+    def init_ask(self, state: JaDEState) -> Tuple[jax.Array, JaDEState]:
+        return state.population, state
+
+    def init_tell(self, state: JaDEState, fitness: jax.Array) -> JaDEState:
+        return state.replace(fitness=fitness)
+
+    def ask(self, state: JaDEState) -> Tuple[jax.Array, JaDEState]:
+        key, kF, kCR, kp, k1, k2, kcr, kj = jax.random.split(state.key, 8)
+        n, d = self.pop_size, self.dim
+        pop = state.population
+
+        F = state.mu_F + 0.1 * jax.random.cauchy(kF, (n,))
+        F = jnp.clip(F, 0.0, 1.0)
+        F = jnp.where(F <= 0.0, 0.1, F)  # resample-degenerate guard
+        CR = jnp.clip(state.mu_CR + 0.1 * jax.random.normal(kCR, (n,)), 0.0, 1.0)
+
+        # current-to-pbest/1: x + F (x_pbest - x) + F (x_r1 - x~_r2)
+        p_idx = jnp.argsort(state.fitness)[: self.p_num]
+        pbest = pop[p_idx[jax.random.randint(kp, (n,), 0, self.p_num)]]
+        r1 = select_rand_indices(k1, n, 1)[:, 0]
+        # r2 from pop ∪ archive (archive entries beyond archive_size masked out)
+        r2_raw = jax.random.randint(k2, (n,), 0, n + n)
+        in_archive = (r2_raw >= n) & ((r2_raw - n) < state.archive_size) & jnp.asarray(
+            self.use_archive
+        )
+        r2_pop = jnp.where(r2_raw >= n, r2_raw - n, r2_raw) % n
+        x_r2 = jnp.where(in_archive[:, None], state.archive[r2_pop], pop[r2_pop])
+
+        mutant = pop + F[:, None] * (pbest - pop) + F[:, None] * (pop[r1] - x_r2)
+        r = jax.random.uniform(kcr, (n, d))
+        j_rand = jax.random.randint(kj, (n, 1), 0, d)
+        mask = (r < CR[:, None]) | (jnp.arange(d) == j_rand)
+        trials = jnp.clip(jnp.where(mask, mutant, pop), self.lb, self.ub)
+        return trials, state.replace(trials=trials, F=F, CR=CR, key=key)
+
+    def tell(self, state: JaDEState, fitness: jax.Array) -> JaDEState:
+        key, k_arch = jax.random.split(state.key)
+        improved = fitness < state.fitness
+        n_success = jnp.sum(improved)
+
+        # adapt means from successful parameters
+        sF = jnp.where(improved, state.F, 0.0)
+        sCR = jnp.where(improved, state.CR, 0.0)
+        lehmer = jnp.sum(sF**2) / jnp.maximum(jnp.sum(sF), 1e-12)
+        arith = jnp.sum(sCR) / jnp.maximum(n_success, 1)
+        any_s = n_success > 0
+        mu_F = jnp.where(any_s, (1 - self.c) * state.mu_F + self.c * lehmer, state.mu_F)
+        mu_CR = jnp.where(any_s, (1 - self.c) * state.mu_CR + self.c * arith, state.mu_CR)
+
+        # archive: replaced parents overwrite random slots once full
+        slots = jax.random.randint(k_arch, (self.pop_size,), 0, self.pop_size)
+        seq = jnp.cumsum(improved.astype(jnp.int32)) - 1 + state.archive_size
+        write_at = jnp.where(seq < self.pop_size, seq, slots)
+        archive = state.archive.at[jnp.where(improved, write_at, self.pop_size)].set(
+            state.population, mode="drop"
+        )
+        archive_size = jnp.minimum(state.archive_size + n_success, self.pop_size)
+
+        return state.replace(
+            population=jnp.where(improved[:, None], state.trials, state.population),
+            fitness=jnp.where(improved, fitness, state.fitness),
+            mu_F=mu_F,
+            mu_CR=mu_CR,
+            archive=archive,
+            archive_size=archive_size,
+            key=key,
+        )

@@ -1,0 +1,132 @@
+"""SaDE — Self-adaptive Differential Evolution (Qin, Huang & Suganthan
+2009, "Differential Evolution Algorithm With Strategy Adaptation for Global
+Numerical Optimization").
+
+Capability parity with reference src/evox/algorithms/so/de_variants/sade.py.
+Four strategies (rand/1/bin, rand-to-best/2/bin, rand/2/bin,
+current-to-rand/1) chosen per individual from success-history probabilities
+over a learning period; CR memory per strategy.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .de import select_rand_indices
+
+_N_STRATEGY = 4
+
+
+class SaDEState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    trials: jax.Array
+    strategy: jax.Array  # (pop,) strategy chosen this generation
+    probs: jax.Array  # (4,) strategy selection probabilities
+    success_mem: jax.Array  # (LP, 4) success counts ring buffer
+    failure_mem: jax.Array
+    CRm: jax.Array  # (4,) per-strategy CR memory
+    gen: jax.Array
+    key: jax.Array
+
+
+class SaDE(Algorithm):
+    def __init__(self, lb, ub, pop_size: int, learning_period: int = 50):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.pop_size = pop_size
+        self.LP = learning_period
+
+    def init(self, key: jax.Array) -> SaDEState:
+        key, k = jax.random.split(key)
+        pop = (
+            jax.random.uniform(k, (self.pop_size, self.dim)) * (self.ub - self.lb)
+            + self.lb
+        )
+        return SaDEState(
+            population=pop,
+            fitness=jnp.full((self.pop_size,), jnp.inf),
+            trials=pop,
+            strategy=jnp.zeros((self.pop_size,), jnp.int32),
+            probs=jnp.full((_N_STRATEGY,), 1.0 / _N_STRATEGY),
+            success_mem=jnp.zeros((self.LP, _N_STRATEGY)),
+            failure_mem=jnp.zeros((self.LP, _N_STRATEGY)),
+            CRm=jnp.full((_N_STRATEGY,), 0.5),
+            gen=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+
+    def init_ask(self, state: SaDEState) -> Tuple[jax.Array, SaDEState]:
+        return state.population, state
+
+    def init_tell(self, state: SaDEState, fitness: jax.Array) -> SaDEState:
+        return state.replace(fitness=fitness)
+
+    def ask(self, state: SaDEState) -> Tuple[jax.Array, SaDEState]:
+        key, ks, kF, kCR, ki, kcr, kj, krec = jax.random.split(state.key, 8)
+        n, d = self.pop_size, self.dim
+        pop = state.population
+        strategy = jax.random.choice(ks, _N_STRATEGY, (n,), p=state.probs)
+        F = jnp.clip(0.5 + 0.3 * jax.random.normal(kF, (n, 1)), 1e-3, 2.0)
+        CR = jnp.clip(
+            state.CRm[strategy][:, None] + 0.1 * jax.random.normal(kCR, (n, 1)),
+            0.0,
+            1.0,
+        )
+        idx = select_rand_indices(ki, n, 5)
+        r1, r2, r3, r4, r5 = (idx[:, i] for i in range(5))
+        best = pop[jnp.argmin(state.fitness)]
+        rec = jax.random.uniform(krec, (n, 1))
+
+        v0 = pop[r1] + F * (pop[r2] - pop[r3])  # rand/1
+        v1 = pop + F * (best - pop) + F * (pop[r1] - pop[r2]) + F * (
+            pop[r3] - pop[r4]
+        )  # rand-to-best/2
+        v2 = pop[r1] + F * (pop[r2] - pop[r3]) + F * (pop[r4] - pop[r5])  # rand/2
+        v3 = pop + rec * (pop[r1] - pop) + F * (pop[r2] - pop[r3])  # cur-to-rand
+
+        r = jax.random.uniform(kcr, (n, d))
+        j_rand = jax.random.randint(kj, (n, 1), 0, d)
+        mask = (r < CR) | (jnp.arange(d) == j_rand)
+        with_cross = lambda v: jnp.where(mask, v, pop)
+        candidates = jnp.stack(
+            [with_cross(v0), with_cross(v1), with_cross(v2), v3], axis=0
+        )
+        trials = jnp.take_along_axis(
+            candidates, strategy[None, :, None], axis=0
+        ).squeeze(0)
+        trials = jnp.clip(trials, self.lb, self.ub)
+        return trials, state.replace(trials=trials, strategy=strategy, key=key)
+
+    def tell(self, state: SaDEState, fitness: jax.Array) -> SaDEState:
+        improved = fitness < state.fitness
+        onehot = jax.nn.one_hot(state.strategy, _N_STRATEGY)
+        succ = (improved[:, None] * onehot).sum(axis=0)
+        fail = ((~improved)[:, None] * onehot).sum(axis=0)
+        slot = state.gen % self.LP
+        success_mem = state.success_mem.at[slot].set(succ)
+        failure_mem = state.failure_mem.at[slot].set(fail)
+
+        warmed = state.gen >= self.LP
+        S = success_mem.sum(axis=0)
+        Fl = failure_mem.sum(axis=0)
+        rate = S / jnp.maximum(S + Fl, 1.0) + 0.01
+        probs = jnp.where(warmed, rate / rate.sum(), state.probs)
+        # CR memory: mean successful CR proxied by success-weighted strategy rate
+        CRm = jnp.where(warmed, jnp.clip(rate / jnp.max(rate), 0.1, 0.9), state.CRm)
+
+        return state.replace(
+            population=jnp.where(improved[:, None], state.trials, state.population),
+            fitness=jnp.where(improved, fitness, state.fitness),
+            probs=probs,
+            success_mem=success_mem,
+            failure_mem=failure_mem,
+            CRm=CRm,
+            gen=state.gen + 1,
+        )

@@ -1,0 +1,137 @@
+"""SHADE — Success-History based Adaptive DE (Tanabe & Fukunaga 2013).
+
+Capability parity with reference src/evox/algorithms/so/de_variants/shade.py.
+current-to-pbest/1 with external archive; an H-slot success-history memory of
+(M_F, M_CR) pairs updated with weighted Lehmer / weighted arithmetic means of
+the generation's successful parameters.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .de import select_rand_indices
+
+
+class SHADEState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    trials: jax.Array
+    F: jax.Array
+    CR: jax.Array
+    M_F: jax.Array  # (H,)
+    M_CR: jax.Array
+    mem_pos: jax.Array
+    archive: jax.Array
+    archive_size: jax.Array
+    key: jax.Array
+
+
+class SHADE(Algorithm):
+    def __init__(self, lb, ub, pop_size: int, memory_size: int = 100):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.pop_size = pop_size
+        self.H = memory_size
+
+    def init(self, key: jax.Array) -> SHADEState:
+        key, k = jax.random.split(key)
+        pop = (
+            jax.random.uniform(k, (self.pop_size, self.dim)) * (self.ub - self.lb)
+            + self.lb
+        )
+        return SHADEState(
+            population=pop,
+            fitness=jnp.full((self.pop_size,), jnp.inf),
+            trials=pop,
+            F=jnp.full((self.pop_size,), 0.5),
+            CR=jnp.full((self.pop_size,), 0.5),
+            M_F=jnp.full((self.H,), 0.5),
+            M_CR=jnp.full((self.H,), 0.5),
+            mem_pos=jnp.zeros((), jnp.int32),
+            archive=pop,
+            archive_size=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+
+    def init_ask(self, state: SHADEState) -> Tuple[jax.Array, SHADEState]:
+        return state.population, state
+
+    def init_tell(self, state: SHADEState, fitness: jax.Array) -> SHADEState:
+        return state.replace(fitness=fitness)
+
+    def ask(self, state: SHADEState) -> Tuple[jax.Array, SHADEState]:
+        key, kh, kF, kCR, kp, k1, k2, kcr, kj, kpb = jax.random.split(state.key, 10)
+        n, d = self.pop_size, self.dim
+        pop = state.population
+
+        h = jax.random.randint(kh, (n,), 0, self.H)
+        F = jnp.clip(state.M_F[h] + 0.1 * jax.random.cauchy(kF, (n,)), 0.0, 1.0)
+        F = jnp.where(F <= 0.0, 0.1, F)
+        CR = jnp.clip(state.M_CR[h] + 0.1 * jax.random.normal(kCR, (n,)), 0.0, 1.0)
+
+        # per-individual p in [2/n, 0.2] (SHADE's per-trial pbest rate)
+        p = jax.random.uniform(kpb, (n,), minval=2.0 / n, maxval=0.2)
+        p_num = jnp.maximum(1, (p * n).astype(jnp.int32))
+        order = jnp.argsort(state.fitness)
+        pbest_rank = (jax.random.uniform(kp, (n,)) * p_num).astype(jnp.int32)
+        pbest = pop[order[pbest_rank]]
+
+        r1 = select_rand_indices(k1, n, 1)[:, 0]
+        r2_raw = jax.random.randint(k2, (n,), 0, 2 * n)
+        in_archive = (r2_raw >= n) & ((r2_raw - n) < state.archive_size)
+        r2 = jnp.where(r2_raw >= n, r2_raw - n, r2_raw) % n
+        x_r2 = jnp.where(in_archive[:, None], state.archive[r2], pop[r2])
+
+        mutant = pop + F[:, None] * (pbest - pop) + F[:, None] * (pop[r1] - x_r2)
+        r = jax.random.uniform(kcr, (n, d))
+        j_rand = jax.random.randint(kj, (n, 1), 0, d)
+        mask = (r < CR[:, None]) | (jnp.arange(d) == j_rand)
+        trials = jnp.where(mask, mutant, pop)
+        # SHADE bound handling: reflect midway toward the violated bound
+        trials = jnp.where(trials < self.lb, (pop + self.lb) / 2, trials)
+        trials = jnp.where(trials > self.ub, (pop + self.ub) / 2, trials)
+        return trials, state.replace(trials=trials, F=F, CR=CR, key=key)
+
+    def tell(self, state: SHADEState, fitness: jax.Array) -> SHADEState:
+        key, k_arch = jax.random.split(state.key)
+        improved = fitness < state.fitness
+        n_success = jnp.sum(improved)
+        # weighted by fitness improvement (SHADE eq. 7-9)
+        w_raw = jnp.where(improved, state.fitness - fitness, 0.0)
+        w = w_raw / jnp.maximum(jnp.sum(w_raw), 1e-12)
+        mF = jnp.sum(w * state.F**2) / jnp.maximum(jnp.sum(w * state.F), 1e-12)
+        mCR = jnp.sum(w * state.CR)
+        any_s = n_success > 0
+        M_F = jnp.where(
+            any_s, state.M_F.at[state.mem_pos].set(mF), state.M_F
+        )
+        M_CR = jnp.where(
+            any_s, state.M_CR.at[state.mem_pos].set(mCR), state.M_CR
+        )
+        mem_pos = jnp.where(any_s, (state.mem_pos + 1) % self.H, state.mem_pos)
+
+        slots = jax.random.randint(k_arch, (self.pop_size,), 0, self.pop_size)
+        seq = jnp.cumsum(improved.astype(jnp.int32)) - 1 + state.archive_size
+        write_at = jnp.where(seq < self.pop_size, seq, slots)
+        archive = state.archive.at[
+            jnp.where(improved, write_at, self.pop_size)
+        ].set(state.population, mode="drop")
+        archive_size = jnp.minimum(state.archive_size + n_success, self.pop_size)
+
+        return state.replace(
+            population=jnp.where(improved[:, None], state.trials, state.population),
+            fitness=jnp.where(improved, fitness, state.fitness),
+            M_F=M_F,
+            M_CR=M_CR,
+            mem_pos=mem_pos,
+            archive=archive,
+            archive_size=archive_size,
+            key=key,
+        )

@@ -1,4 +1,22 @@
 from .pso import PSO, PSOState
 from .cso import CSO, CSOState
+from .clpso import CLPSO
+from .sl_pso import SLPSOGS, SLPSOUS
+from .fips import FIPS
+from .dms_pso_el import DMSPSOEL
+from .fs_pso import FSPSO
+from . import topology
 
-__all__ = ["PSO", "PSOState", "CSO", "CSOState"]
+__all__ = [
+    "PSO",
+    "PSOState",
+    "CSO",
+    "CSOState",
+    "CLPSO",
+    "SLPSOGS",
+    "SLPSOUS",
+    "FIPS",
+    "DMSPSOEL",
+    "FSPSO",
+    "topology",
+]

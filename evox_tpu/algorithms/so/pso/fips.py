@@ -1,0 +1,90 @@
+"""FIPS — Fully Informed Particle Swarm (Mendes, Kennedy & Neves 2004).
+
+Capability parity with reference src/evox/algorithms/so/pso_variants/fips.py.
+Constriction-coefficient PSO where each particle is pulled toward *all* its
+neighbors' pbests (equally weighted), over a configurable topology from
+:mod:`.topology`.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+from .topology import full_neighbours, ring_neighbours, square_neighbours
+
+
+class FIPSState(PyTreeNode):
+    population: jax.Array
+    velocity: jax.Array
+    pbest: jax.Array
+    pbest_fitness: jax.Array
+    key: jax.Array
+
+
+class FIPS(Algorithm):
+    def __init__(
+        self,
+        lb,
+        ub,
+        pop_size: int,
+        topology: str = "ring",  # "ring" | "square" | "full"
+        phi: float = 4.1,
+    ):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.pop_size = pop_size
+        self.phi = phi
+        # Clerc constriction coefficient
+        self.chi = 2.0 / abs(2.0 - phi - ((phi**2 - 4 * phi) ** 0.5).real) if phi > 4 else 0.7298
+        if topology == "ring":
+            self.neighbours = ring_neighbours(pop_size, 1)
+        elif topology == "square":
+            self.neighbours = square_neighbours(pop_size)
+        elif topology == "full":
+            self.neighbours = full_neighbours(pop_size)
+        else:
+            raise ValueError(f"unknown topology {topology!r}")
+
+    def init(self, key: jax.Array) -> FIPSState:
+        key, kp, kv = jax.random.split(key, 3)
+        span = self.ub - self.lb
+        pop = jax.random.uniform(kp, (self.pop_size, self.dim)) * span + self.lb
+        v = (jax.random.uniform(kv, (self.pop_size, self.dim)) * 2 - 1) * span * 0.1
+        return FIPSState(
+            population=pop,
+            velocity=v,
+            pbest=pop,
+            pbest_fitness=jnp.full((self.pop_size,), jnp.inf),
+            key=key,
+        )
+
+    def init_ask(self, state: FIPSState) -> Tuple[jax.Array, FIPSState]:
+        return state.population, state
+
+    def init_tell(self, state: FIPSState, fitness: jax.Array) -> FIPSState:
+        return state.replace(pbest_fitness=fitness)
+
+    def ask(self, state: FIPSState) -> Tuple[jax.Array, FIPSState]:
+        key, k_r = jax.random.split(state.key)
+        n, d = self.pop_size, self.dim
+        k = self.neighbours.shape[1]
+        # phi split uniformly across neighbors, with random per-neighbor dims
+        r = jax.random.uniform(k_r, (n, k, d)) * (self.phi / k)
+        nbr_pbest = state.pbest[self.neighbours]  # (n, k, d)
+        social = jnp.sum(r * (nbr_pbest - state.population[:, None, :]), axis=1)
+        v = self.chi * (state.velocity + social)
+        pop = jnp.clip(state.population + v, self.lb, self.ub)
+        return pop, state.replace(population=pop, velocity=v, key=key)
+
+    def tell(self, state: FIPSState, fitness: jax.Array) -> FIPSState:
+        improved = fitness < state.pbest_fitness
+        return state.replace(
+            pbest=jnp.where(improved[:, None], state.population, state.pbest),
+            pbest_fitness=jnp.where(improved, fitness, state.pbest_fitness),
+        )

@@ -1,0 +1,103 @@
+"""FS-PSO — Feature-Selection PSO (reference src/evox/algorithms/so/
+pso_variants/fs_pso.py; Xue, Zhang & Browne 2013 style). Classic
+inertia-weight PSO whose particles live in [0, 1]^d and are thresholded into
+binary feature masks by the evaluation side; mutation kicks particles out of
+saturated positions.
+
+(The reference defines but does not export this class — kept here for full
+capability coverage.)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ....core.algorithm import Algorithm
+from ....core.struct import PyTreeNode
+
+
+class FSPSOState(PyTreeNode):
+    population: jax.Array
+    velocity: jax.Array
+    pbest: jax.Array
+    pbest_fitness: jax.Array
+    gbest: jax.Array
+    gbest_fitness: jax.Array
+    key: jax.Array
+
+
+class FSPSO(Algorithm):
+    def __init__(
+        self,
+        pop_size: int,
+        dim: int,
+        inertia_weight: float = 0.7298,
+        cognitive_coefficient: float = 1.49445,
+        social_coefficient: float = 1.49445,
+        mutate_rate: float = 0.01,
+    ):
+        self.dim = dim
+        self.pop_size = pop_size
+        self.lb = jnp.zeros((dim,), dtype=jnp.float32)
+        self.ub = jnp.ones((dim,), dtype=jnp.float32)
+        self.w = inertia_weight
+        self.phi_p = cognitive_coefficient
+        self.phi_g = social_coefficient
+        self.mutate_rate = mutate_rate
+
+    def init(self, key: jax.Array) -> FSPSOState:
+        key, kp, kv = jax.random.split(key, 3)
+        pop = jax.random.uniform(kp, (self.pop_size, self.dim))
+        v = (jax.random.uniform(kv, (self.pop_size, self.dim)) * 2 - 1) * 0.2
+        return FSPSOState(
+            population=pop,
+            velocity=v,
+            pbest=pop,
+            pbest_fitness=jnp.full((self.pop_size,), jnp.inf),
+            gbest=pop[0],
+            gbest_fitness=jnp.asarray(jnp.inf),
+            key=key,
+        )
+
+    def init_ask(self, state: FSPSOState) -> Tuple[jax.Array, FSPSOState]:
+        return state.population, state
+
+    def init_tell(self, state: FSPSOState, fitness: jax.Array) -> FSPSOState:
+        best = jnp.argmin(fitness)
+        return state.replace(
+            pbest_fitness=fitness,
+            gbest=state.population[best],
+            gbest_fitness=fitness[best],
+        )
+
+    def ask(self, state: FSPSOState) -> Tuple[jax.Array, FSPSOState]:
+        key, kp, kg, km, kmv = jax.random.split(state.key, 5)
+        n, d = self.pop_size, self.dim
+        rp = jax.random.uniform(kp, (n, d))
+        rg = jax.random.uniform(kg, (n, d))
+        v = (
+            self.w * state.velocity
+            + self.phi_p * rp * (state.pbest - state.population)
+            + self.phi_g * rg * (state.gbest - state.population)
+        )
+        pop = state.population + v
+        # bit-flip style mutation in the continuous relaxation
+        mutate = jax.random.bernoulli(km, self.mutate_rate, (n, d))
+        pop = jnp.where(mutate, jax.random.uniform(kmv, (n, d)), pop)
+        pop = jnp.clip(pop, self.lb, self.ub)
+        return pop, state.replace(population=pop, velocity=v, key=key)
+
+    def tell(self, state: FSPSOState, fitness: jax.Array) -> FSPSOState:
+        improved = fitness < state.pbest_fitness
+        pbest = jnp.where(improved[:, None], state.population, state.pbest)
+        pbest_fitness = jnp.where(improved, fitness, state.pbest_fitness)
+        best = jnp.argmin(pbest_fitness)
+        return state.replace(
+            pbest=pbest,
+            pbest_fitness=pbest_fitness,
+            gbest=pbest[best],
+            gbest_fitness=pbest_fitness[best],
+        )

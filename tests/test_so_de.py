@@ -1,0 +1,49 @@
+"""DE-family convergence tests on Sphere (reference test strategy:
+tests/test_single_objective_algorithms.py)."""
+
+import jax
+import jax.numpy as jnp
+
+from evox_tpu import StdWorkflow
+from evox_tpu.algorithms import DE, ODE, CoDE, JaDE, SaDE, SHADE
+from evox_tpu.monitors import EvalMonitor
+from evox_tpu.problems.numerical import Sphere
+
+DIM = 5
+LB, UB = -10.0 * jnp.ones(DIM), 10.0 * jnp.ones(DIM)
+
+
+def run_algorithm(algo, steps, seed=11):
+    monitor = EvalMonitor()
+    wf = StdWorkflow(algo, Sphere(), monitors=(monitor,))
+    state = wf.init(jax.random.PRNGKey(seed))
+    state = wf.run(state, steps)
+    return float(monitor.get_best_fitness(state.monitors[0]))
+
+
+def test_de_rand():
+    assert run_algorithm(DE(LB, UB, pop_size=100), 100) < 0.1
+
+
+def test_de_best():
+    assert run_algorithm(DE(LB, UB, pop_size=100, base_vector="best"), 60) < 0.1
+
+
+def test_ode():
+    assert run_algorithm(ODE(LB, UB, pop_size=100), 100) < 0.1
+
+
+def test_code():
+    assert run_algorithm(CoDE(LB, UB, pop_size=100), 60) < 0.1
+
+
+def test_jade():
+    assert run_algorithm(JaDE(LB, UB, pop_size=100), 60) < 0.1
+
+
+def test_sade():
+    assert run_algorithm(SaDE(LB, UB, pop_size=100), 60) < 0.1
+
+
+def test_shade():
+    assert run_algorithm(SHADE(LB, UB, pop_size=100), 60) < 0.1

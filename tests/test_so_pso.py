@@ -1,0 +1,83 @@
+"""PSO-family convergence tests on Sphere + topology golden tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu import StdWorkflow
+from evox_tpu.algorithms.so.pso import (
+    CLPSO,
+    DMSPSOEL,
+    FIPS,
+    FSPSO,
+    SLPSOGS,
+    SLPSOUS,
+    topology,
+)
+from evox_tpu.monitors import EvalMonitor
+from evox_tpu.problems.numerical import Sphere
+
+DIM = 5
+LB, UB = -10.0 * jnp.ones(DIM), 10.0 * jnp.ones(DIM)
+
+
+def run_algorithm(algo, steps, seed=5):
+    monitor = EvalMonitor()
+    wf = StdWorkflow(algo, Sphere(), monitors=(monitor,))
+    state = wf.init(jax.random.PRNGKey(seed))
+    state = wf.run(state, steps)
+    return float(monitor.get_best_fitness(state.monitors[0]))
+
+
+def test_clpso():
+    assert run_algorithm(CLPSO(LB, UB, pop_size=50), 200) < 0.5
+
+
+def test_slpso_gs():
+    assert run_algorithm(SLPSOGS(LB, UB, pop_size=100), 200) < 0.5
+
+
+def test_slpso_us():
+    assert run_algorithm(SLPSOUS(LB, UB, pop_size=100), 200) < 0.5
+
+
+def test_fips():
+    assert run_algorithm(FIPS(LB, UB, pop_size=64, topology="ring"), 200) < 0.1
+
+
+def test_dms_pso_el():
+    algo = DMSPSOEL(LB, UB, pop_size=60, sub_swarm_size=10, max_iteration=200)
+    assert run_algorithm(algo, 200) < 0.5
+
+
+def test_fspso():
+    assert run_algorithm(FSPSO(pop_size=50, dim=DIM), 100) < 0.5
+
+
+# ---- topology golden tests -------------------------------------------------
+
+def test_ring_neighbours():
+    idx = topology.ring_neighbours(5, 1)
+    np.testing.assert_array_equal(np.asarray(idx[0]), [4, 0, 1])
+    np.testing.assert_array_equal(np.asarray(idx[4]), [3, 4, 0])
+
+
+def test_square_neighbours():
+    idx = topology.square_neighbours(6)  # 2x3 grid
+    assert idx.shape == (6, 5)
+    assert int(idx[0, 0]) == 0  # self first
+
+
+def test_neighbour_best():
+    fit = jnp.asarray([3.0, 1.0, 2.0, 0.5])
+    nbrs = topology.ring_neighbours(4, 1)
+    nb = topology.neighbour_best(fit, nbrs)
+    np.testing.assert_array_equal(np.asarray(nb), [3, 1, 3, 3])
+
+
+def test_knn_adjacency_symmetric():
+    pos = jax.random.normal(jax.random.PRNGKey(0), (10, 3))
+    adj = topology.knn_adjacency(pos, 3)
+    assert bool(jnp.all(adj == adj.T))
+    idx, mask = topology.adjacency_to_neighbour_list(adj, 6)
+    assert idx.shape == (10, 6)
