@@ -1,7 +1,11 @@
-from . import so
+from . import so, mo
 from .so.pso import PSO, CSO
 from .so.es import *  # noqa: F401,F403 — full ES surface
 from .so.de import *  # noqa: F401,F403 — full DE surface
+from .mo import *  # noqa: F401,F403 — full MO surface
 from .so import es as _es, de as _de
+from . import mo as _mo
 
-__all__ = ["so", "PSO", "CSO"] + list(_es.__all__) + list(_de.__all__)
+__all__ = ["so", "mo", "PSO", "CSO"] + list(_es.__all__) + list(_de.__all__) + list(
+    _mo.__all__
+)

@@ -1,0 +1,40 @@
+from .nsga2 import NSGA2
+from .nsga3 import NSGA3
+from .moead import MOEAD
+from .moead_variants import MOEADDRA, MOEADM2M
+from .rvea import RVEA
+from .rveaa import RVEAa
+from .ibea import IBEA
+from .bce_ibea import BCEIBEA
+from .eag_moead import EAGMOEAD
+from .hype import HypE
+from .knea import KnEA
+from .bige import BiGE
+from .gde3 import GDE3
+from .spea2 import SPEA2
+from .sra import SRA
+from .tdea import TDEA
+from .lmocso import LMOCSO
+from .im_moea import IMMOEA
+
+__all__ = [
+    "NSGA2",
+    "NSGA3",
+    "MOEAD",
+    "MOEADDRA",
+    "MOEADM2M",
+    "RVEA",
+    "RVEAa",
+    "IBEA",
+    "BCEIBEA",
+    "EAGMOEAD",
+    "HypE",
+    "KnEA",
+    "BiGE",
+    "GDE3",
+    "SPEA2",
+    "SRA",
+    "TDEA",
+    "LMOCSO",
+    "IMMOEA",
+]

@@ -1,0 +1,58 @@
+"""HypE (Bader & Zitzler 2011): hypervolume-estimation based many-objective
+EA. Capability parity with reference src/evox/algorithms/mo/hype.py:56+
+(Monte-Carlo hypervolume-contribution fitness, fixed sample budget so the
+whole selection stays one static-shape jit program)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...operators.selection.basic import tournament
+from .common import GAMOAlgorithm, MOState
+
+
+def hype_fitness(
+    key: jax.Array, fit: jax.Array, k: int, n_samples: int = 8192
+) -> jax.Array:
+    """Monte-Carlo HypE fitness: expected hypervolume share each individual
+    would contribute if the k worst were removed (higher = better)."""
+    n, m = fit.shape
+    ref = jnp.max(fit, axis=0) * 1.2 + 1e-6
+    lo = jnp.min(fit, axis=0)
+    samples = jax.random.uniform(key, (n_samples, m)) * (ref - lo) + lo
+    # dominated[s, i]: sample s is dominated by individual i
+    dominated = jnp.all(fit[None, :, :] <= samples[:, None, :], axis=-1)
+    count = jnp.sum(dominated, axis=1)  # how many individuals cover s
+    # HypE weight alpha_j for a point covered by j individuals (j = 1..k)
+    j = jnp.arange(1, n + 1, dtype=jnp.float32)
+    alpha = jnp.where(
+        j <= k,
+        jnp.cumprod(jnp.concatenate([jnp.ones((1,)), (k - j[:-1]) / (n - j[:-1])]))
+        / j,
+        0.0,
+    )
+    w = jnp.where(count > 0, alpha[jnp.clip(count - 1, 0, n - 1)], 0.0)  # (s,)
+    return jnp.sum(dominated * w[:, None], axis=0)
+
+
+class HypE(GAMOAlgorithm):
+    def __init__(self, lb, ub, n_objs, pop_size, n_samples: int = 8192):
+        super().__init__(lb, ub, n_objs, pop_size)
+        self.n_samples = n_samples
+
+    def mate(self, key: jax.Array, state: MOState) -> jax.Array:
+        k1, k2 = jax.random.split(key)
+        score = hype_fitness(k1, state.fitness, self.pop_size, self.n_samples)
+        return tournament(k2, state.population, -score)
+
+    def tell(self, state: MOState, fitness: jax.Array) -> MOState:
+        key, k_h = jax.random.split(state.key)
+        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        k_remove = merged_fit.shape[0] - self.pop_size
+        score = hype_fitness(k_h, merged_fit, k_remove, self.n_samples)
+        idx = jnp.argsort(-score)[: self.pop_size]
+        return state.replace(
+            population=merged_pop[idx], fitness=merged_fit[idx], key=key
+        )

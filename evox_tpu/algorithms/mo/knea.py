@@ -1,0 +1,44 @@
+"""KnEA (Zhang, Tian & Jin 2015): knee-point driven many-objective EA.
+Capability parity with reference src/evox/algorithms/mo/knea.py:39+:
+knee points = maximal distance to the extreme hyperplane within adaptive
+neighborhoods; selection prefers (rank, knee, distance)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...operators.selection.non_dominate import non_dominated_sort
+from ...utils.common import pairwise_euclidean_dist
+from .common import GAMOAlgorithm, MOState
+
+
+def _hyperplane_distance(fit: jax.Array) -> jax.Array:
+    """Signed distance of each point to the hyperplane through the extreme
+    values of the current set (larger = more knee-like, for minimization)."""
+    fmax = jnp.max(fit, axis=0)
+    fmin = jnp.min(fit, axis=0)
+    w = 1.0 / jnp.maximum(fmax - fmin, 1e-12)
+    b = jnp.sum(w * fmax)
+    return (b - fit @ w) / jnp.linalg.norm(w)
+
+
+class KnEA(GAMOAlgorithm):
+    def __init__(self, lb, ub, n_objs, pop_size, knee_rate: float = 0.5):
+        super().__init__(lb, ub, n_objs, pop_size)
+        self.knee_rate = knee_rate
+
+    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
+        rank = non_dominated_sort(fit)
+        dist = _hyperplane_distance(fit)
+        # neighborhood knee detection: a point is a knee if it has the max
+        # hyperplane distance within its K-nearest neighborhood
+        n = fit.shape[0]
+        K = max(1, int(n * self.knee_rate * 0.1))
+        pd = pairwise_euclidean_dist(fit, fit)
+        _, nbr = jax.lax.top_k(-pd, K + 1)  # includes self
+        knee = dist >= jnp.max(dist[nbr], axis=1)
+        # order: rank asc, knees first within rank, then distance desc
+        order = jnp.lexsort((-dist, ~knee, rank))
+        idx = order[: self.pop_size]
+        return pop[idx], fit[idx]

@@ -1,0 +1,120 @@
+"""LMOCSO (Tian et al. 2020): large-scale multi-objective competitive swarm
+optimizer. Capability parity with reference src/evox/algorithms/mo/
+lmocso.py:44+. Pairwise competitions on a shift-based fitness; losers learn
+from winners with the two-stage velocity update; environmental selection by
+reference-vector guided (APD) selection."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.struct import PyTreeNode
+from ...operators.mutation.ops import polynomial
+from ...operators.sampling.uniform import UniformSampling
+from .common import uniform_init
+from ...core.algorithm import Algorithm
+from .rvea import ref_vec_guided_indices
+from .sra import _sde_density
+
+
+class LMOCSOState(PyTreeNode):
+    population: jax.Array
+    velocity: jax.Array
+    fitness: jax.Array
+    offspring: jax.Array
+    off_velocity: jax.Array
+    gen: jax.Array
+    key: jax.Array
+
+
+class LMOCSO(Algorithm):
+    def __init__(self, lb, ub, n_objs: int, pop_size: int, max_gen: int = 100,
+                 alpha: float = 2.0):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.n_objs = n_objs
+        v, n = UniformSampling(pop_size, n_objs)()
+        self.vectors = v / jnp.linalg.norm(v, axis=1, keepdims=True)
+        self.pop_size = n if n % 2 == 0 else n + (2 - n % 2)
+        self.nv = n
+        self.max_gen = max_gen
+        self.alpha = alpha
+
+    def init(self, key: jax.Array) -> LMOCSOState:
+        key, k = jax.random.split(key)
+        pop = uniform_init(k, self.lb, self.ub, self.pop_size)
+        half = self.pop_size // 2
+        return LMOCSOState(
+            population=pop,
+            velocity=jnp.zeros_like(pop),
+            fitness=jnp.full((self.pop_size, self.n_objs), jnp.inf),
+            offspring=jnp.zeros((half, self.dim)),
+            off_velocity=jnp.zeros((half, self.dim)),
+            gen=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+
+    def init_ask(self, state: LMOCSOState) -> Tuple[jax.Array, LMOCSOState]:
+        return state.population, state
+
+    def init_tell(self, state: LMOCSOState, fitness: jax.Array) -> LMOCSOState:
+        return state.replace(fitness=fitness)
+
+    def ask(self, state: LMOCSOState) -> Tuple[jax.Array, LMOCSOState]:
+        key, k_pair, k0, k1, k_m = jax.random.split(state.key, 5)
+        n = self.pop_size
+        half = n // 2
+        # shift-based fitness (SDE): sparser + closer = better
+        fmin = jnp.min(state.fitness, axis=0)
+        fmax = jnp.max(state.fitness, axis=0)
+        fn = (state.fitness - fmin) / jnp.maximum(fmax - fmin, 1e-12)
+        score = jnp.sum(fn, axis=1) - _sde_density(state.fitness)
+
+        perm = jax.random.permutation(k_pair, n).reshape(2, half)
+        a_wins = score[perm[0]] < score[perm[1]]
+        winners = jnp.where(a_wins, perm[0], perm[1])
+        losers = jnp.where(a_wins, perm[1], perm[0])
+
+        r0 = jax.random.uniform(k0, (half, self.dim))
+        r1 = jax.random.uniform(k1, (half, self.dim))
+        xw, xl = state.population[winners], state.population[losers]
+        # two-stage update (LMOCSO eq. 6-7): accelerate, then move twice
+        v_new = r0 * state.velocity[losers] + r1 * (xw - xl)
+        x_new = xl + v_new + r0 * (v_new - state.velocity[losers])
+        x_new = polynomial(k_m, x_new, (self.lb, self.ub))
+        x_new = jnp.clip(x_new, self.lb, self.ub)
+
+        # winners keep their velocity; updated losers carry the new one
+        velocity = state.velocity.at[losers].set(v_new)
+        return x_new, state.replace(
+            offspring=x_new,
+            off_velocity=v_new,
+            velocity=velocity,
+            key=key,
+        )
+
+    def tell(self, state: LMOCSOState, fitness: jax.Array) -> LMOCSOState:
+        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
+        merged_v = jnp.concatenate([state.velocity, state.off_velocity], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        theta = (state.gen.astype(jnp.float32) / self.max_gen) ** self.alpha
+        winner, has = ref_vec_guided_indices(merged_fit, self.vectors, theta)
+        sel_pop = jnp.where(has[:, None], merged_pop[winner], 0.0)
+        sel_fit = jnp.where(
+            has[:, None], merged_fit[winner], jnp.full((1, self.n_objs), jnp.inf)
+        )
+        sel_v = jnp.where(has[:, None], merged_v[winner], 0.0)  # survivors keep momentum
+        reps = -(-self.pop_size // sel_pop.shape[0])
+        pop = jnp.tile(sel_pop, (reps, 1))[: self.pop_size]
+        fit = jnp.tile(sel_fit, (reps, 1))[: self.pop_size]
+        vel = jnp.tile(sel_v, (reps, 1))[: self.pop_size]
+        return state.replace(
+            population=pop,
+            fitness=fit,
+            velocity=vel,
+            gen=state.gen + 1,
+        )

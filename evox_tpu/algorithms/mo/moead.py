@@ -1,0 +1,132 @@
+"""MOEA/D (Zhang & Li 2007). Capability parity with reference
+src/evox/algorithms/mo/moead.py:19-129: Das-Dennis weight vectors, T-nearest
+weight neighborhoods, per-subproblem DE-less GA variation and neighborhood
+replacement by aggregation value.
+
+TPU note: the reference updates neighborhoods with a ``lax.scan`` over
+subproblems (moead.py:114-129) because replacement is order-dependent; here
+each generation proposes one offspring per subproblem and performs the
+neighborhood replacement as one batched scatter-min — order-free, fully
+parallel across the pop axis, at the cost of at most one extra generation of
+propagation (convergence behavior verified by the IGD tests).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.algorithm import Algorithm
+from ...core.struct import PyTreeNode
+from ...operators.crossover.sbx import simulated_binary
+from ...operators.mutation.ops import polynomial
+from ...operators.sampling.uniform import UniformSampling
+from ...utils.aggregation import AggregationFunction
+from ...utils.common import pairwise_euclidean_dist
+from .common import uniform_init
+
+
+class MOEADState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    ideal: jax.Array
+    offspring: jax.Array
+    key: jax.Array
+
+
+class MOEAD(Algorithm):
+    def __init__(
+        self,
+        lb,
+        ub,
+        n_objs: int,
+        pop_size: int,
+        aggregate_op: str = "pbi",
+        n_neighbors: int = None,
+        max_replace: int = 4,
+    ):
+        self.lb = jnp.asarray(lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(ub, dtype=jnp.float32)
+        self.dim = int(self.lb.shape[0])
+        self.n_objs = n_objs
+        w, n = UniformSampling(pop_size, n_objs)()
+        self.weights = w
+        self.pop_size = n  # actual pop = number of weight vectors
+        self.T = n_neighbors or min(max(2, n // 5), 20)
+        dist = pairwise_euclidean_dist(w, w)
+        self.neighbors = jnp.argsort(dist, axis=1)[:, : self.T]  # (n, T)
+        self.agg = AggregationFunction(aggregate_op)
+        self.nr = max_replace  # replacement cap per offspring (MOEA/D's n_r)
+
+    def init(self, key: jax.Array) -> MOEADState:
+        key, k = jax.random.split(key)
+        pop = uniform_init(k, self.lb, self.ub, self.pop_size)
+        return MOEADState(
+            population=pop,
+            fitness=jnp.full((self.pop_size, self.n_objs), jnp.inf),
+            ideal=jnp.full((self.n_objs,), jnp.inf),
+            offspring=pop,
+            key=key,
+        )
+
+    def init_ask(self, state: MOEADState) -> Tuple[jax.Array, MOEADState]:
+        return state.population, state
+
+    def init_tell(self, state: MOEADState, fitness: jax.Array) -> MOEADState:
+        return state.replace(fitness=fitness, ideal=jnp.min(fitness, axis=0))
+
+    def ask(self, state: MOEADState) -> Tuple[jax.Array, MOEADState]:
+        key, k_pick, k_x, k_m = jax.random.split(state.key, 4)
+        n = self.pop_size
+        # parents: the subproblem's own solution x_i + one random neighbor
+        picks = jax.random.randint(k_pick, (n,), 0, self.T)
+        mate = self.neighbors[jnp.arange(n), picks]
+        parents = jnp.stack(
+            [state.population, state.population[mate]], axis=1
+        ).reshape(2 * n, self.dim)
+        off = simulated_binary(k_x, parents)[0::2]  # one child per subproblem
+        off = polynomial(k_m, off, (self.lb, self.ub))
+        return off, state.replace(offspring=off, key=key)
+
+    def tell(self, state: MOEADState, fitness: jax.Array) -> MOEADState:
+        n = self.pop_size
+        ideal = jnp.minimum(state.ideal, jnp.min(fitness, axis=0))
+        # offspring i may replace any of its neighborhood's incumbents where
+        # it improves the neighbor's aggregation value; batched scatter-min
+        nbr = self.neighbors  # (n, T)
+        w_nbr = self.weights[nbr]  # (n, T, m)
+        off_val = self.agg(fitness[:, None, :], w_nbr, ideal)  # (n, T)
+        inc_val = self.agg(state.fitness[nbr], w_nbr, ideal)  # (n, T)
+        better = off_val < inc_val  # (n, T)
+        # n_r cap: each offspring may displace at most nr incumbents. The
+        # slot side is already capped at one offspring per slot by the
+        # scatter-min below, so nr here is looser than the sequential
+        # reference's n_r=2 — together they bound total displacement while
+        # keeping every subproblem update independent (fully parallel).
+        improvement = jnp.where(better, inc_val - off_val, -jnp.inf)
+        thresh = jnp.sort(improvement, axis=1)[:, -self.nr]  # nr-th best
+        better = better & (improvement >= thresh[:, None])
+
+        # for each incumbent slot j, pick the best replacing offspring value
+        flat_slots = nbr.reshape(-1)
+        flat_vals = jnp.where(better, off_val, jnp.inf).reshape(-1)
+        best_val = jnp.full((n,), jnp.inf).at[flat_slots].min(flat_vals)
+        # winner offspring index per slot (argmin via equality on best value)
+        cand_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, self.T)).reshape(-1)
+        is_winner = flat_vals == best_val[flat_slots]
+        winner = (
+            jnp.full((n,), n, dtype=jnp.int32)
+            .at[flat_slots]
+            .min(jnp.where(is_winner, cand_idx, n).astype(jnp.int32))
+        )
+        # a slot with no improving offspring has best_val == inf and every
+        # inf entry would tie as "winner" — gate on finiteness
+        replace = (winner < n) & jnp.isfinite(best_val)
+        safe_winner = jnp.where(replace, winner, 0)
+        population = jnp.where(
+            replace[:, None], state.offspring[safe_winner], state.population
+        )
+        fit = jnp.where(replace[:, None], fitness[safe_winner], state.fitness)
+        return state.replace(population=population, fitness=fit, ideal=ideal)

@@ -1,0 +1,28 @@
+"""NSGA-II (Deb et al. 2002). Capability parity with reference
+src/evox/algorithms/mo/nsga2.py:23-96: merge parents + offspring, then
+(rank, crowding) environmental selection; mating by binary tournament on
+(rank, -crowding)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...operators.selection.non_dominate import (
+    crowding_distance,
+    non_dominate,
+    non_dominated_sort,
+)
+from ...operators.selection.basic import tournament_multifit
+from .common import GAMOAlgorithm, MOState
+
+
+class NSGA2(GAMOAlgorithm):
+    def mate(self, key: jax.Array, state: MOState) -> jax.Array:
+        rank = non_dominated_sort(state.fitness)
+        crowd = crowding_distance(state.fitness)
+        keys = jnp.stack([rank.astype(jnp.float32), -crowd], axis=1)
+        return tournament_multifit(key, state.population, keys)
+
+    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
+        return non_dominate(pop, fit, self.pop_size)

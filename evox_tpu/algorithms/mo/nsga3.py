@@ -1,0 +1,104 @@
+"""NSGA-III (Deb & Jain 2014): reference-point based many-objective NSGA.
+Capability parity with reference src/evox/algorithms/mo/nsga3.py:27-199:
+ideal/nadir normalization with hyperplane intercepts (and fallback), cosine
+association to Das-Dennis points, and the one-pick-per-iteration niching
+``lax.while_loop``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...operators.sampling.uniform import UniformSampling
+from ...operators.selection.non_dominate import non_dominated_sort
+from .common import GAMOAlgorithm, MOState
+
+
+def _normalize(fit: jax.Array) -> jax.Array:
+    """Normalize objectives by ideal point and hyperplane intercepts built
+    from per-axis extreme points (ASF), falling back to max when the
+    hyperplane is degenerate (reference nsga3.py:105-132)."""
+    m = fit.shape[1]
+    ideal = jnp.min(fit, axis=0)
+    f = fit - ideal
+    # extreme point per axis: min achievement scalarizing function
+    w = jnp.eye(m) + 1e-6
+    asf = jnp.max(f[:, None, :] / w[None, :, :], axis=-1)  # (n, m)
+    extreme = f[jnp.argmin(asf, axis=0)]  # (m, m)
+
+    def intercepts():
+        b = jnp.ones((m,))
+        plane = jnp.linalg.solve(extreme, b)
+        return 1.0 / plane
+
+    nadir_fallback = jnp.max(f, axis=0)
+    det = jnp.linalg.det(extreme)
+    a = jax.lax.cond(
+        jnp.abs(det) > 1e-10,
+        intercepts,
+        lambda: nadir_fallback,
+    )
+    a = jnp.where((a > 1e-10) & jnp.isfinite(a), a, nadir_fallback)
+    a = jnp.maximum(a, 1e-10)
+    return f / a
+
+
+class NSGA3(GAMOAlgorithm):
+    def __init__(self, lb, ub, n_objs: int, pop_size: int):
+        super().__init__(lb, ub, n_objs, pop_size)
+        refs, n = UniformSampling(pop_size, n_objs)()
+        self.refs = refs / jnp.linalg.norm(refs, axis=1, keepdims=True)
+        self.pop_size = n
+
+    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
+        n = fit.shape[0]
+        k = self.pop_size
+        rank = non_dominated_sort(fit)
+        order = jnp.argsort(rank, stable=True)
+        last_rank = rank[order[k - 1]]
+
+        selected = rank < last_rank  # full fronts that fit entirely
+        candidate = rank == last_rank  # the split front
+
+        fn = _normalize(fit)
+        # association: max cosine == min perpendicular distance direction
+        norm = jnp.linalg.norm(fn, axis=1, keepdims=True)
+        cos = (fn @ self.refs.T) / jnp.maximum(norm, 1e-12)
+        pi = jnp.argmax(cos, axis=1)  # (n,) associated ref point
+        dist = norm[:, 0] * jnp.sqrt(jnp.maximum(1.0 - jnp.max(cos, axis=1) ** 2, 0.0))
+
+        nref = self.refs.shape[0]
+        rho = jnp.zeros((nref,), jnp.int32).at[jnp.where(selected, pi, nref)].add(
+            1, mode="drop"
+        )
+        need = k - jnp.sum(selected.astype(jnp.int32))
+
+        def cond(carry):
+            _, _, _, taken = carry
+            return taken < need
+
+        def body(carry):
+            selected, candidate, rho, taken = carry
+            # niche count per ref among refs that still have candidates
+            has_cand = (
+                jnp.zeros((nref,), bool)
+                .at[jnp.where(candidate, pi, nref)]
+                .set(True, mode="drop")
+            )
+            rho_masked = jnp.where(has_cand, rho, jnp.iinfo(jnp.int32).max)
+            j = jnp.argmin(rho_masked)  # least-crowded ref with candidates
+            # pick the closest candidate of ref j
+            cand_j = candidate & (pi == j)
+            i = jnp.argmin(jnp.where(cand_j, dist, jnp.inf))
+            return (
+                selected.at[i].set(True),
+                candidate.at[i].set(False),
+                rho.at[j].add(1),
+                taken + 1,
+            )
+
+        selected, _, _, _ = jax.lax.while_loop(
+            cond, body, (selected, candidate, rho, jnp.int32(0))
+        )
+        idx = jnp.argsort(~selected, stable=True)[:k]
+        return pop[idx], fit[idx]

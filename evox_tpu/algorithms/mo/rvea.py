@@ -1,0 +1,148 @@
+"""RVEA (Cheng, Jin, Olhofer & Sendhoff 2016): reference-vector guided EA
+with angle-penalized distance (APD) selection and periodic vector
+adaptation. Capability parity with reference src/evox/algorithms/mo/
+rvea.py:17-140 and operators/selection/rvea_selection.py."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.struct import PyTreeNode
+from ...operators.sampling.uniform import UniformSampling
+from ...utils.common import cos_dist
+from .common import GAMOAlgorithm, MOState, uniform_init
+
+
+def ref_vec_guided_indices(
+    fitness: jax.Array,
+    vectors: jax.Array,
+    theta: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """APD selection winners: per reference vector, the index of the
+    minimal-APD individual assigned to it. Returns ``(winner, has)`` where
+    ``winner`` is (n_vectors,) indices (0 where empty) and ``has`` marks
+    non-empty niches."""
+    n, m = fitness.shape
+    nv = vectors.shape[0]
+    translated = fitness - jnp.min(fitness, axis=0)
+    # angle to each reference vector
+    cos = jnp.clip(cos_dist(translated, vectors), -1.0, 1.0)  # (n, nv)
+    assigned = jnp.argmax(cos, axis=1)  # (n,)
+
+    # per-vector minimum angle between vectors (gamma normalizer)
+    vcos = jnp.clip(cos_dist(vectors, vectors), -1.0, 1.0)
+    vcos = vcos - 2.0 * jnp.eye(nv)
+    gamma = jnp.arccos(jnp.clip(jnp.max(vcos, axis=1), -1.0, 1.0))
+    gamma = jnp.maximum(gamma, 1e-6)
+
+    angle = jnp.arccos(jnp.clip(cos[jnp.arange(n), assigned], -1.0, 1.0))
+    norm = jnp.linalg.norm(translated, axis=1)
+    apd = (1.0 + m * theta * angle / gamma[assigned]) * norm
+
+    # segment-argmin over assigned vectors
+    INF = jnp.inf
+    val = jnp.where(norm > 0, apd, INF)  # guard all-zero rows
+    best_val = jnp.full((nv,), INF).at[assigned].min(val)
+    is_best = val == best_val[assigned]
+    winner = (
+        jnp.full((nv,), n, dtype=jnp.int32)
+        .at[assigned]
+        .min(jnp.where(is_best, jnp.arange(n), n).astype(jnp.int32))
+    )
+    has = winner < n
+    return jnp.where(has, winner, 0), has
+
+
+def ref_vec_guided(
+    pop: jax.Array,
+    fitness: jax.Array,
+    vectors: jax.Array,
+    theta: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """APD selection: pick at most one individual per reference vector.
+
+    Returns (pop_out, fit_out) with exactly ``len(vectors)`` rows; empty
+    niches are filled with inf-fitness placeholder rows (reference
+    rvea_selection.py:8-54 keeps nan rows; inf keeps downstream math total).
+    """
+    nv, m = vectors.shape[0], fitness.shape[1]
+    winner, has = ref_vec_guided_indices(fitness, vectors, theta)
+    pop_out = jnp.where(has[:, None], pop[winner], jnp.zeros_like(pop[winner]))
+    fit_out = jnp.where(has[:, None], fitness[winner], jnp.full((nv, m), jnp.inf))
+    return pop_out, fit_out
+
+
+class RVEAState(PyTreeNode):
+    population: jax.Array
+    fitness: jax.Array
+    vectors: jax.Array
+    offspring: jax.Array
+    gen: jax.Array
+    key: jax.Array
+
+
+class RVEA(GAMOAlgorithm):
+    def __init__(
+        self,
+        lb,
+        ub,
+        n_objs: int,
+        pop_size: int,
+        alpha: float = 2.0,
+        fr: float = 0.1,
+        max_gen: int = 100,
+    ):
+        super().__init__(lb, ub, n_objs, pop_size)
+        v, n = UniformSampling(pop_size, n_objs)()
+        self.v0 = v / jnp.linalg.norm(v, axis=1, keepdims=True)
+        self.pop_size = n
+        self.alpha = alpha
+        self.fr = fr
+        self.max_gen = max_gen
+        self.adapt_every = max(1, int(fr * max_gen))
+
+    def init(self, key: jax.Array) -> RVEAState:
+        key, k = jax.random.split(key)
+        pop = uniform_init(k, self.lb, self.ub, self.pop_size)
+        return RVEAState(
+            population=pop,
+            fitness=jnp.full((self.pop_size, self.n_objs), jnp.inf),
+            vectors=self.v0,
+            offspring=pop,
+            gen=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+
+    def ask(self, state: RVEAState) -> Tuple[jax.Array, RVEAState]:
+        key, k_mate, k_var = jax.random.split(state.key, 3)
+        # mate only among the valid (finite-fitness) niche winners
+        n_rows = state.population.shape[0]
+        valid = jnp.all(jnp.isfinite(state.fitness), axis=1)
+        p = jax.random.choice(
+            k_mate,
+            n_rows,
+            (n_rows,),
+            p=valid.astype(jnp.float32) / jnp.maximum(jnp.sum(valid), 1),
+        )
+        off = self.variation(k_var, state.population[p])
+        return off, state.replace(offspring=off, key=key)
+
+    def tell(self, state: RVEAState, fitness: jax.Array) -> RVEAState:
+        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        theta = (state.gen.astype(jnp.float32) / self.max_gen) ** self.alpha
+        pop, fit = ref_vec_guided(merged_pop, merged_fit, state.vectors, theta)
+
+        gen = state.gen + 1
+        # periodic reference-vector adaptation to the current objective ranges
+        finite = jnp.all(jnp.isfinite(fit), axis=1)
+        fmax = jnp.max(jnp.where(finite[:, None], fit, -jnp.inf), axis=0)
+        fmin = jnp.min(jnp.where(finite[:, None], fit, jnp.inf), axis=0)
+        scale = jnp.maximum(fmax - fmin, 1e-6)
+        adapted = self.v0 * scale
+        adapted = adapted / jnp.linalg.norm(adapted, axis=1, keepdims=True)
+        vectors = jnp.where(gen % self.adapt_every == 0, adapted, state.vectors)
+        return state.replace(population=pop, fitness=fit, vectors=vectors, gen=gen)

@@ -1,0 +1,57 @@
+"""RVEA* (RVEAa) — RVEA with reference-vector regeneration for irregular
+Pareto fronts (Cheng et al. 2016, §V). Capability parity with reference
+src/evox/algorithms/mo/rveaa.py:63+. Keeps a second, *adaptive* vector set
+regenerated from the population's objective distribution each adaptation
+cycle; selection runs over the union of both sets."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .rvea import RVEA, RVEAState, ref_vec_guided
+from .common import uniform_init
+
+
+class RVEAa(RVEA):
+    def init(self, key: jax.Array) -> RVEAState:
+        key, k = jax.random.split(key)
+        nv = self.v0.shape[0]
+        pop = uniform_init(k, self.lb, self.ub, 2 * nv)
+        return RVEAState(
+            population=pop,
+            fitness=jnp.full((2 * nv, self.n_objs), jnp.inf),
+            vectors=jnp.concatenate([self.v0, self.v0], axis=0),  # [fixed, adaptive]
+            offspring=pop,
+            gen=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+
+    def tell(self, state: RVEAState, fitness: jax.Array) -> RVEAState:
+        nv = self.v0.shape[0]
+        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        theta = (state.gen.astype(jnp.float32) / self.max_gen) ** self.alpha
+        pop, fit = ref_vec_guided(merged_pop, merged_fit, state.vectors, theta)
+
+        gen = state.gen + 1
+        key, k_regen = jax.random.split(state.key)
+        # regenerate the adaptive half from random *unit* directions scaled by
+        # the population's objective ranges (targets irregular fronts)
+        finite = jnp.all(jnp.isfinite(fit), axis=1)
+        fmax = jnp.max(jnp.where(finite[:, None], fit, -jnp.inf), axis=0)
+        fmin = jnp.min(jnp.where(finite[:, None], fit, jnp.inf), axis=0)
+        scale = jnp.maximum(fmax - fmin, 1e-6)
+        rand = jax.random.uniform(k_regen, (nv, self.n_objs)) * scale
+        rand = rand / jnp.maximum(
+            jnp.linalg.norm(rand, axis=1, keepdims=True), 1e-12
+        )
+        adapt = state.gen % self.adapt_every == 0
+        new_vectors = jnp.where(
+            adapt,
+            jnp.concatenate([self.v0, rand], axis=0),
+            state.vectors,
+        )
+        return state.replace(
+            population=pop, fitness=fit, vectors=new_vectors, gen=gen, key=key
+        )

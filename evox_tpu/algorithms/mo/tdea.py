@@ -1,0 +1,48 @@
+"""θ-DEA (Yuan, Xu, Wang & Yao 2016): theta-dominance based EA.
+Capability parity with reference src/evox/algorithms/mo/tdea.py:100+.
+Individuals are clustered to reference vectors; within each cluster the PBI
+scalarization (d1 + theta*d2) defines theta-dominance; selection is Pareto
+front peeling in theta-rank plus the classic normalization."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...operators.sampling.uniform import UniformSampling
+from ...operators.selection.non_dominate import non_dominated_sort
+from .common import GAMOAlgorithm, MOState
+from .nsga3 import _normalize
+
+
+class TDEA(GAMOAlgorithm):
+    def __init__(self, lb, ub, n_objs, pop_size, theta: float = 5.0):
+        super().__init__(lb, ub, n_objs, pop_size)
+        refs, n = UniformSampling(pop_size, n_objs)()
+        self.refs = refs / jnp.linalg.norm(refs, axis=1, keepdims=True)
+        self.theta = theta
+        self.pop_size = n
+
+    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
+        fn = _normalize(fit)
+        norm = jnp.linalg.norm(fn, axis=1, keepdims=True)
+        cos = (fn @ self.refs.T) / jnp.maximum(norm, 1e-12)
+        cluster = jnp.argmax(cos, axis=1)
+        d1 = norm[:, 0] * jnp.max(cos, axis=1)
+        d2 = norm[:, 0] * jnp.sqrt(jnp.maximum(1.0 - jnp.max(cos, axis=1) ** 2, 0.0))
+        pbi = d1 + self.theta * d2
+        # theta-rank: position of each individual inside its cluster by pbi
+        n = fit.shape[0]
+        order = jnp.lexsort((pbi, cluster))  # cluster-major, pbi asc
+        sorted_cluster = cluster[order]
+        new_cluster = jnp.concatenate(
+            [jnp.ones((1,), bool), sorted_cluster[1:] != sorted_cluster[:-1]]
+        )
+        pos_in_cluster = jnp.arange(n) - jnp.maximum.accumulate(
+            jnp.where(new_cluster, jnp.arange(n), 0)
+        )
+        theta_rank = jnp.zeros((n,), jnp.int32).at[order].set(pos_in_cluster)
+        # Pareto rank as primary, theta-rank to fill niches evenly
+        rank = non_dominated_sort(fit)
+        idx = jnp.lexsort((pbi, theta_rank, rank))[: self.pop_size]
+        return pop[idx], fit[idx]

@@ -27,6 +27,7 @@ class SaDEState(PyTreeNode):
     fitness: jax.Array
     trials: jax.Array
     strategy: jax.Array  # (pop,) strategy chosen this generation
+    CR: jax.Array  # (pop,) crossover rate sampled this generation
     probs: jax.Array  # (4,) strategy selection probabilities
     success_mem: jax.Array  # (LP, 4) success counts ring buffer
     failure_mem: jax.Array
@@ -54,6 +55,7 @@ class SaDE(Algorithm):
             fitness=jnp.full((self.pop_size,), jnp.inf),
             trials=pop,
             strategy=jnp.zeros((self.pop_size,), jnp.int32),
+            CR=jnp.full((self.pop_size,), 0.5),
             probs=jnp.full((_N_STRATEGY,), 1.0 / _N_STRATEGY),
             success_mem=jnp.zeros((self.LP, _N_STRATEGY)),
             failure_mem=jnp.zeros((self.LP, _N_STRATEGY)),
@@ -102,7 +104,9 @@ class SaDE(Algorithm):
             candidates, strategy[None, :, None], axis=0
         ).squeeze(0)
         trials = jnp.clip(trials, self.lb, self.ub)
-        return trials, state.replace(trials=trials, strategy=strategy, key=key)
+        return trials, state.replace(
+            trials=trials, strategy=strategy, CR=CR[:, 0], key=key
+        )
 
     def tell(self, state: SaDEState, fitness: jax.Array) -> SaDEState:
         improved = fitness < state.fitness
@@ -118,8 +122,10 @@ class SaDE(Algorithm):
         Fl = failure_mem.sum(axis=0)
         rate = S / jnp.maximum(S + Fl, 1.0) + 0.01
         probs = jnp.where(warmed, rate / rate.sum(), state.probs)
-        # CR memory: mean successful CR proxied by success-weighted strategy rate
-        CRm = jnp.where(warmed, jnp.clip(rate / jnp.max(rate), 0.1, 0.9), state.CRm)
+        # CR memory: mean of the CR values that actually succeeded, per strategy
+        succ_cr = (improved[:, None] * onehot) * state.CR[:, None]  # (pop, 4)
+        mean_cr = jnp.sum(succ_cr, axis=0) / jnp.maximum(succ, 1.0)
+        CRm = jnp.where(warmed & (succ > 0), mean_cr, state.CRm)
 
         return state.replace(
             population=jnp.where(improved[:, None], state.trials, state.population),

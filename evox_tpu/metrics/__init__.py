@@ -1,0 +1,16 @@
+from .igd import igd, igd_plus, IGD, IGDPlus
+from .gd import gd, gd_plus, GD, GDPlus
+from .hypervolume import hypervolume_mc, HV
+
+__all__ = [
+    "igd",
+    "igd_plus",
+    "IGD",
+    "IGDPlus",
+    "gd",
+    "gd_plus",
+    "GD",
+    "GDPlus",
+    "hypervolume_mc",
+    "HV",
+]

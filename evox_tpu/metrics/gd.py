@@ -1,0 +1,38 @@
+"""Generational Distance (+ GD+). Capability parity with reference
+src/evox/metrics/gd.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.common import pairwise_euclidean_dist
+
+
+def gd(objs: jax.Array, pf: jax.Array, p: float = 1.0) -> jax.Array:
+    """Mean distance from each solution to its nearest true-front point."""
+    d = pairwise_euclidean_dist(objs, pf)
+    return jnp.mean(jnp.min(d, axis=1) ** p) ** (1.0 / p)
+
+
+def gd_plus(objs: jax.Array, pf: jax.Array) -> jax.Array:
+    diff = jnp.maximum(objs[:, None, :] - pf[None, :, :], 0.0)
+    d = jnp.linalg.norm(diff, axis=-1)
+    return jnp.mean(jnp.min(d, axis=1))
+
+
+class GD:
+    def __init__(self, pf: jax.Array, p: float = 1.0):
+        self.pf = pf
+        self.p = p
+
+    def __call__(self, objs: jax.Array) -> jax.Array:
+        return gd(objs, self.pf, self.p)
+
+
+class GDPlus:
+    def __init__(self, pf: jax.Array):
+        self.pf = pf
+
+    def __call__(self, objs: jax.Array) -> jax.Array:
+        return gd_plus(objs, self.pf)

@@ -1,0 +1,54 @@
+"""Monte-Carlo hypervolume (reference src/evox/metrics/hypervolume.py:7-96,
+with the same two sampling strategies: one bounding cube, or one cube per
+solution)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def hypervolume_mc(
+    key: jax.Array,
+    objs: jax.Array,
+    ref: jax.Array,
+    num_samples: int = 100_000,
+    sample_method: str = "bounding_cube",
+) -> jax.Array:
+    """Estimate the hypervolume dominated by ``objs`` w.r.t. ``ref``
+    (minimization: volume between the front and the reference point)."""
+    n, m = objs.shape
+    if sample_method == "bounding_cube":
+        lo = jnp.min(objs, axis=0)
+        samples = jax.random.uniform(key, (num_samples, m)) * (ref - lo) + lo
+        dominated = jnp.any(
+            jnp.all(objs[None, :, :] <= samples[:, None, :], axis=-1), axis=1
+        )
+        vol = jnp.prod(ref - lo)
+        return jnp.mean(dominated.astype(jnp.float32)) * vol
+    elif sample_method == "each_cube":
+        # stratified: sample each solution's own [obj_i, ref] cube and
+        # de-overlap by counting multiplicity
+        per = num_samples // n
+        keys = jax.random.split(key, n)
+
+        def one(k, o):
+            s = jax.random.uniform(k, (per, m)) * (ref - o) + o
+            count = jnp.sum(
+                jnp.all(objs[None, :, :] <= s[:, None, :], axis=-1), axis=1
+            )
+            return jnp.sum(1.0 / jnp.maximum(count, 1)) / per * jnp.prod(ref - o)
+
+        return jnp.sum(jax.vmap(one)(keys, objs))
+    raise ValueError(f"unknown sample_method {sample_method!r}")
+
+
+class HV:
+    def __init__(self, ref: jax.Array, num_samples: int = 100_000,
+                 sample_method: str = "bounding_cube"):
+        self.ref = jnp.asarray(ref)
+        self.num_samples = num_samples
+        self.sample_method = sample_method
+
+    def __call__(self, key: jax.Array, objs: jax.Array) -> jax.Array:
+        return hypervolume_mc(key, objs, self.ref, self.num_samples, self.sample_method)

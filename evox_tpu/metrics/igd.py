@@ -1,0 +1,39 @@
+"""Inverted Generational Distance (+ IGD+ variant). Capability parity with
+reference src/evox/metrics/igd.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.common import pairwise_euclidean_dist
+
+
+def igd(objs: jax.Array, pf: jax.Array, p: float = 1.0) -> jax.Array:
+    """Mean distance from each true-front point to its nearest solution."""
+    d = pairwise_euclidean_dist(pf, objs)
+    return jnp.mean(jnp.min(d, axis=1) ** p) ** (1.0 / p)
+
+
+def igd_plus(objs: jax.Array, pf: jax.Array) -> jax.Array:
+    """IGD+ (Ishibuchi et al. 2015): only dominated directions count."""
+    diff = jnp.maximum(objs[None, :, :] - pf[:, None, :], 0.0)
+    d = jnp.linalg.norm(diff, axis=-1)
+    return jnp.mean(jnp.min(d, axis=1))
+
+
+class IGD:
+    def __init__(self, pf: jax.Array, p: float = 1.0):
+        self.pf = pf
+        self.p = p
+
+    def __call__(self, objs: jax.Array) -> jax.Array:
+        return igd(objs, self.pf, self.p)
+
+
+class IGDPlus:
+    def __init__(self, pf: jax.Array):
+        self.pf = pf
+
+    def __call__(self, objs: jax.Array) -> jax.Array:
+        return igd_plus(objs, self.pf)

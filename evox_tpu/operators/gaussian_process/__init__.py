@@ -1,0 +1,4 @@
+from .regression import GPRegression
+from .classification import GPClassification
+
+__all__ = ["GPRegression", "GPClassification"]

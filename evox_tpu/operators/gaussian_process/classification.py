@@ -1,0 +1,30 @@
+"""GP binary classification via the Laplace-free logistic approximation:
+GP regression on {-1, +1} labels squashed through a probit link at predict
+time (Nickisch & Rasmussen's "label regression" baseline). Capability parity
+with reference src/evox/operators/gaussian_process/classification.py:16+
+(gpjax Bernoulli likelihood) at the fidelity the framework uses it.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .regression import GPRegression
+
+
+class GPClassification(GPRegression):
+    def fit(self, x: jax.Array, y: jax.Array):
+        """``y`` in {0, 1} or {-1, +1}."""
+        y = jnp.where(y > 0, 1.0, -1.0)
+        return super().fit(x, y)
+
+    def predict_proba(self, model, x_test: jax.Array) -> jax.Array:
+        mean, var = super().predict(model, x_test)
+        # probit-squashed latent (accounts for predictive variance)
+        return jax.scipy.stats.norm.cdf(mean / jnp.sqrt(1.0 + var))
+
+    def predict_label(self, model, x_test: jax.Array) -> jax.Array:
+        return (self.predict_proba(model, x_test) > 0.5).astype(jnp.int32)

@@ -1,0 +1,173 @@
+"""DTLZ many-objective benchmark suite (Deb, Thiele, Laumanns & Zitzler
+2002). Capability parity with reference src/evox/problems/numerical/
+dtlz.py:8-352 (DTLZ1-7 with ``pf()`` via Das-Dennis reference points).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.problem import Problem
+from ...operators.sampling.uniform import UniformSampling
+
+
+class _DTLZ(Problem):
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 100):
+        self.m = m
+        self.d = d if d is not None else m + 4
+        self.ref_num = ref_num
+
+    def fit_shape(self, pop_size):
+        return (pop_size, self.m)
+
+    def _g1(self, xm: jax.Array) -> jax.Array:
+        """The 100*(k + sum((x-0.5)^2 - cos(20 pi (x-0.5)))) rough g."""
+        k = xm.shape[1]
+        return 100.0 * (
+            k
+            + jnp.sum(
+                (xm - 0.5) ** 2 - jnp.cos(20.0 * jnp.pi * (xm - 0.5)), axis=1
+            )
+        )
+
+    def _g2(self, xm: jax.Array) -> jax.Array:
+        return jnp.sum((xm - 0.5) ** 2, axis=1)
+
+    def _linear_front(self, f):
+        return f / (2.0 * jnp.sum(f, axis=1, keepdims=True))
+
+    def _spherical_front(self, f):
+        return f / jnp.linalg.norm(f, axis=1, keepdims=True)
+
+
+def _cumprod_front(x_angles: jax.Array, m: int) -> jax.Array:
+    """Build [prod cos..., sin] objective cascade used by DTLZ2-6."""
+    cos = jnp.cos(x_angles)
+    sin = jnp.sin(x_angles)
+    fs = []
+    for i in range(m):
+        t = jnp.ones_like(x_angles[:, 0])
+        for j in range(m - 1 - i):
+            t = t * cos[:, j]
+        if i > 0:
+            t = t * sin[:, m - 1 - i]
+        fs.append(t)
+    return jnp.stack(fs, axis=1)
+
+
+class DTLZ1(_DTLZ):
+    def evaluate(self, state, pop):
+        m = self.m
+        xf, xm = pop[:, : m - 1], pop[:, m - 1 :]
+        g = self._g1(xm)
+        ones = jnp.ones((pop.shape[0], 1))
+        cum = jnp.cumprod(jnp.concatenate([ones, xf], axis=1), axis=1)  # (n, m)
+        rev = jnp.concatenate([ones, 1.0 - xf[:, ::-1]], axis=1)
+        f = 0.5 * (1.0 + g)[:, None] * cum[:, ::-1] * rev
+        return f, state
+
+    def pf(self):
+        w, _ = UniformSampling(self.ref_num, self.m)()
+        return w / 2.0
+
+
+class DTLZ2(_DTLZ):
+    _g = _DTLZ._g2
+
+    def evaluate(self, state, pop):
+        m = self.m
+        xf, xm = pop[:, : m - 1], pop[:, m - 1 :]
+        g = self._g(xm)
+        angles = xf * jnp.pi / 2.0
+        f = (1.0 + g)[:, None] * _cumprod_front(angles, m)
+        return f, state
+
+    def pf(self):
+        w, _ = UniformSampling(self.ref_num, self.m)()
+        return w / jnp.linalg.norm(w, axis=1, keepdims=True)
+
+
+class DTLZ3(DTLZ2):
+    _g = _DTLZ._g1
+
+
+class DTLZ4(DTLZ2):
+    def __init__(self, d=None, m=3, ref_num=100, alpha: float = 100.0):
+        super().__init__(d, m, ref_num)
+        self.alpha = alpha
+
+    def evaluate(self, state, pop):
+        m = self.m
+        xf, xm = pop[:, : m - 1] ** self.alpha, pop[:, m - 1 :]
+        g = self._g2(xm)
+        angles = xf * jnp.pi / 2.0
+        f = (1.0 + g)[:, None] * _cumprod_front(angles, m)
+        return f, state
+
+
+class DTLZ5(_DTLZ):
+    _g = _DTLZ._g2
+
+    def evaluate(self, state, pop):
+        m = self.m
+        xf, xm = pop[:, : m - 1], pop[:, m - 1 :]
+        g = self._g(xm)
+        # degenerate curve: bend all but the first angle toward pi/4
+        theta1 = xf[:, :1]
+        rest = (1.0 + 2.0 * g[:, None] * xf[:, 1:]) / (2.0 * (1.0 + g[:, None]))
+        angles = jnp.concatenate([theta1, rest], axis=1) * jnp.pi / 2.0
+        f = (1.0 + g)[:, None] * _cumprod_front(angles, m)
+        return f, state
+
+    def pf(self):
+        n = self.ref_num
+        x = jnp.linspace(0.0, 1.0, n)[:, None] * jnp.pi / 2.0
+        f = jnp.concatenate(
+            [jnp.cos(x), jnp.sin(x)], axis=1
+        )  # 2-D curve embedded in m-D
+        m = self.m
+        # lift: f = (cos(t)/sqrt(2)^(m-2), ..., sin(t))
+        cols = [f[:, 0:1] / (jnp.sqrt(2.0) ** (m - 2))]
+        for i in range(1, m - 1):
+            cols.append(f[:, 0:1] / (jnp.sqrt(2.0) ** (m - 1 - i)))
+        cols.append(f[:, 1:2])
+        return jnp.concatenate(cols, axis=1)
+
+
+class DTLZ6(DTLZ5):
+    def _g(self, xm):
+        return jnp.sum(xm**0.1, axis=1)
+
+
+class DTLZ7(_DTLZ):
+    def __init__(self, d=None, m=3, ref_num=100):
+        if d is None:
+            d = m + 19
+        super().__init__(d, m, ref_num)
+
+    def evaluate(self, state, pop):
+        m = self.m
+        xf, xm = pop[:, : m - 1], pop[:, m - 1 :]
+        g = 1.0 + 9.0 * jnp.mean(xm, axis=1)
+        h = m - jnp.sum(
+            xf / (1.0 + g[:, None]) * (1.0 + jnp.sin(3.0 * jnp.pi * xf)), axis=1
+        )
+        f = jnp.concatenate([xf, ((1.0 + g) * h)[:, None]], axis=1)
+        return f, state
+
+    def pf(self):
+        # sample the disconnected front by filtering a dense grid
+        from ...operators.selection.non_dominate import non_dominated_sort
+
+        n = self.ref_num * 10
+        w, _ = UniformSampling(n, self.m - 1)() if self.m > 2 else (
+            jnp.linspace(0, 1, n)[:, None],
+            n,
+        )
+        x = w[:, : self.m - 1]
+        h = self.m - jnp.sum(x / 2.0 * (1.0 + jnp.sin(3.0 * jnp.pi * x)), axis=1)
+        pts = jnp.concatenate([x, (2.0 * h)[:, None]], axis=1)
+        rank = non_dominated_sort(pts)
+        keep = jnp.argsort(rank, stable=True)[: self.ref_num]
+        return pts[jnp.sort(keep)]

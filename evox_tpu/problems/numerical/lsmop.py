@@ -1,0 +1,193 @@
+"""LSMOP large-scale multi-objective test suite (Cheng, Jin & Olhofer 2017,
+IEEE Trans. Cybernetics 47(12):4108-4121). Capability parity with reference
+src/evox/problems/numerical/lsmop.py:18-454, re-designed table-driven: each
+LSMOPk is a (variable linkage, inner-function pair, front geometry) triple
+over one shared batched evaluator.
+
+Decision-space convention (the suite's standard): the first ``m - 1``
+"position" variables live in [0, 1]; the remaining "distance" variables in
+[0, 10]; use :meth:`bounds` for algorithm lb/ub.
+
+Note: the reference's ``pf()`` for the linear-front members (LSMOP1-4)
+returns the simplex halved (a DTLZ1 habit), but with g = 0 these fronts sum
+to 1, not 0.5 — behavior, not API, so the correct unit simplex is returned
+here (SURVEY.md §2.4 note on not replicating reference bugs).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.problem import Problem
+from ...operators.sampling.uniform import UniformSampling
+from .basic import ackley_func, griewank_func, rosenbrock_func, sphere_func
+
+
+def _schwefel_max(x: jax.Array) -> jax.Array:
+    return jnp.max(jnp.abs(x), axis=-1)
+
+
+def _rastrigin(x: jax.Array) -> jax.Array:
+    return jnp.sum(x**2 - 10.0 * jnp.cos(2.0 * jnp.pi * x) + 10.0, axis=-1)
+
+
+class _LSMOPBase(Problem):
+    #: pair of inner g-functions cycled over the m objective groups
+    inner: Sequence[Callable] = (sphere_func,)
+    #: "linear" (LSMOP1-4) or "nonlinear" (LSMOP5-9) variable linkage
+    linkage: str = "linear"
+    #: "linear" | "sphere" | "disconnected" front geometry
+    front: str = "linear"
+
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 100):
+        self.m = m
+        self.d = d if d is not None else 100 * m
+        self.ref_num = ref_num
+        self.nk = 5
+        # chaos-series subgroup lengths (suite eq. 6)
+        c = [3.8 * 0.1 * (1 - 0.1)]
+        for _ in range(1, m):
+            c.append(3.8 * c[-1] * (1 - c[-1]))
+        c = jnp.asarray(c)
+        budget = self.d - (m - 1)
+        sublen = jnp.floor(c / jnp.sum(c) * budget / self.nk)
+        self.sublen = tuple(int(s) for s in sublen)
+        starts = [0]
+        for s in self.sublen:
+            starts.append(starts[-1] + s * self.nk)
+        self.group_start = tuple(starts[:-1])
+
+    def bounds(self) -> Tuple[jax.Array, jax.Array]:
+        lb = jnp.zeros((self.d,))
+        ub = jnp.ones((self.d,)).at[self.m - 1 :].set(10.0)
+        return lb, ub
+
+    def fit_shape(self, pop_size):
+        return (pop_size, self.m)
+
+    # ------------------------------------------------------------------ core
+    def _link(self, x: jax.Array) -> jax.Array:
+        """Variable linkage applied to the distance part (suite eq. 8/9)."""
+        n, d = x.shape
+        m = self.m
+        i = jnp.arange(m, d + 1, dtype=jnp.float32)
+        if self.linkage == "linear":
+            scale = 1.0 + i / d
+        else:
+            scale = 1.0 + jnp.cos(i / d * jnp.pi / 2.0)
+        xs = scale * x[:, m - 1 :] - 10.0 * x[:, :1]
+        return jnp.concatenate([x[:, : m - 1], xs], axis=1)
+
+    def _g(self, x: jax.Array) -> jax.Array:
+        """Per-objective mean of the inner function over nk subcomponents."""
+        m = self.m
+        gs = []
+        for i in range(m):
+            func = self.inner[i % len(self.inner)]
+            sublen = self.sublen[i]
+            acc = 0.0
+            for j in range(self.nk):
+                start = self.group_start[i] + (m - 1) + j * sublen
+                acc = acc + func(x[:, start : start + sublen])
+            gs.append(acc / max(sublen, 1) / self.nk)
+        return jnp.stack(gs, axis=1)  # (n, m)
+
+    def evaluate(self, state, pop):
+        n = pop.shape[0]
+        m = self.m
+        x = self._link(pop)
+        g = self._g(x)
+        ones = jnp.ones((n, 1))
+        xf = x[:, : m - 1]
+        if self.front == "linear":
+            cum = jnp.cumprod(jnp.concatenate([ones, xf], axis=1), axis=1)[:, ::-1]
+            rev = jnp.concatenate([ones, 1.0 - xf[:, ::-1]], axis=1)
+            f = (1.0 + g) * cum * rev
+        elif self.front == "sphere":
+            g_shift = 1.0 + g + jnp.concatenate([g[:, 1:], jnp.zeros((n, 1))], axis=1)
+            cos = jnp.cos(xf * jnp.pi / 2.0)
+            sin = jnp.sin(xf[:, ::-1] * jnp.pi / 2.0)
+            cum = jnp.cumprod(jnp.concatenate([ones, cos], axis=1), axis=1)[:, ::-1]
+            rev = jnp.concatenate([ones, sin], axis=1)
+            f = g_shift * cum * rev
+        else:  # disconnected (LSMOP9, DTLZ7-like)
+            gsum = 1.0 + jnp.sum(g, axis=1, keepdims=True)
+            h = self.m - jnp.sum(
+                xf / (1.0 + gsum) * (1.0 + jnp.sin(3.0 * jnp.pi * xf)),
+                axis=1,
+                keepdims=True,
+            )
+            f = jnp.concatenate([xf, (1.0 + gsum) * h], axis=1)
+        return f, state
+
+    # ------------------------------------------------------------------ front
+    def pf(self):
+        w, _ = UniformSampling(self.ref_num, self.m)()
+        if self.front == "linear":
+            return w
+        if self.front == "sphere":
+            return w / jnp.linalg.norm(w, axis=1, keepdims=True)
+        # disconnected: filter a dense curve like DTLZ7
+        from ...operators.selection.non_dominate import non_dominated_sort
+
+        x = (
+            UniformSampling(self.ref_num * 10, self.m - 1)()[0]
+            if self.m > 2
+            else jnp.linspace(0, 1, self.ref_num * 10)[:, None]
+        )
+        h = self.m - jnp.sum(
+            x / 2.0 * (1.0 + jnp.sin(3.0 * jnp.pi * x)), axis=1, keepdims=True
+        )
+        pts = jnp.concatenate([x, 2.0 * h], axis=1)
+        rank = non_dominated_sort(pts)
+        keep = jnp.argsort(rank, stable=True)[: self.ref_num]
+        return pts[jnp.sort(keep)]
+
+
+class LSMOP1(_LSMOPBase):
+    inner = (sphere_func,)
+    linkage, front = "linear", "linear"
+
+
+class LSMOP2(_LSMOPBase):
+    inner = (griewank_func, _schwefel_max)
+    linkage, front = "linear", "linear"
+
+
+class LSMOP3(_LSMOPBase):
+    inner = (_rastrigin, rosenbrock_func)
+    linkage, front = "linear", "linear"
+
+
+class LSMOP4(_LSMOPBase):
+    inner = (ackley_func, griewank_func)
+    linkage, front = "linear", "linear"
+
+
+class LSMOP5(_LSMOPBase):
+    inner = (sphere_func,)
+    linkage, front = "nonlinear", "sphere"
+
+
+class LSMOP6(_LSMOPBase):
+    inner = (rosenbrock_func, _schwefel_max)
+    linkage, front = "nonlinear", "sphere"
+
+
+class LSMOP7(_LSMOPBase):
+    inner = (ackley_func, rosenbrock_func)
+    linkage, front = "nonlinear", "sphere"
+
+
+class LSMOP8(_LSMOPBase):
+    inner = (griewank_func, sphere_func)
+    linkage, front = "nonlinear", "sphere"
+
+
+class LSMOP9(_LSMOPBase):
+    inner = (sphere_func, ackley_func)
+    linkage, front = "nonlinear", "disconnected"

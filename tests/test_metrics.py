@@ -1,0 +1,49 @@
+"""Metric golden tests, mirroring reference tests/test_metrics.py
+(closed-form GD/IGD values; Monte-Carlo HV vs analytic)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu.metrics import gd, gd_plus, hypervolume_mc, igd, igd_plus
+
+
+PF = jnp.asarray([[0.0, 1.0], [0.5, 0.5], [1.0, 0.0]])
+OBJS = jnp.asarray([[0.0, 1.5], [1.0, 0.5]])
+
+
+def test_gd_closed_form():
+    # nearest distances: [0,1.5]->[0,1]=0.5 ; [1,0.5]->[0.5,0.5] or [1,0]=0.5
+    np.testing.assert_allclose(float(gd(OBJS, PF)), 0.5, rtol=1e-5)
+
+
+def test_igd_closed_form():
+    # per-PF-point nearest solution distances:
+    # [0,1]->0.5 ; [0.5,0.5]->0.5 ; [1,0]->0.5
+    np.testing.assert_allclose(float(igd(OBJS, PF)), 0.5, rtol=1e-5)
+
+
+def test_gd_plus_dominated_only():
+    objs = jnp.asarray([[0.0, 0.5]])  # dominates PF point [0,1]
+    assert float(gd_plus(objs, PF)) == 0.0
+
+
+def test_igd_plus_leq_igd():
+    assert float(igd_plus(OBJS, PF)) <= float(igd(OBJS, PF)) + 1e-6
+
+
+def test_hypervolume_mc_vs_analytic():
+    # single point [0.5, 0.5] with ref [1, 1]: HV = 0.25
+    objs = jnp.asarray([[0.5, 0.5]])
+    hv = hypervolume_mc(jax.random.PRNGKey(0), objs, jnp.asarray([1.0, 1.0]))
+    np.testing.assert_allclose(float(hv), 0.25, atol=0.01)
+
+
+def test_hypervolume_each_cube():
+    objs = jnp.asarray([[0.25, 0.75], [0.75, 0.25]])
+    # exact: 2 * 0.75*0.25 - overlap 0.25*0.25 = 0.3125
+    hv = hypervolume_mc(
+        jax.random.PRNGKey(1), objs, jnp.asarray([1.0, 1.0]),
+        sample_method="each_cube",
+    )
+    np.testing.assert_allclose(float(hv), 0.3125, atol=0.01)

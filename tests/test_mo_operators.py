@@ -1,0 +1,56 @@
+"""Golden-value tests for the MO kernels, mirroring the reference's
+tests/test_non_dominated_sort.py and tests/test_crowding_distance.py."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu.operators.selection.non_dominate import (
+    crowding_distance,
+    non_dominate,
+    non_dominated_sort,
+)
+from evox_tpu.utils.common import dominate_relation
+
+
+def test_dominate_relation():
+    x = jnp.asarray([[1.0, 2.0], [2.0, 1.0], [0.5, 0.5], [1.0, 2.0]])
+    d = np.asarray(dominate_relation(x, x))
+    # point 2 dominates everyone else; equal points don't dominate each other
+    assert d[2, 0] and d[2, 1] and d[2, 3]
+    assert not d[0, 1] and not d[1, 0]
+    assert not d[0, 3] and not d[3, 0]
+    assert not np.any(np.diagonal(d))
+
+
+def test_non_dominated_sort_known_ranks():
+    # hand-built 2-objective set with three fronts
+    fit = jnp.asarray(
+        [
+            [1.0, 5.0],  # front 0
+            [2.0, 3.0],  # front 0
+            [4.0, 1.0],  # front 0
+            [2.0, 6.0],  # front 1 (dominated by [1,5])
+            [3.0, 3.5],  # front 1 (dominated by [2,3])
+            [5.0, 5.0],  # front 2
+        ]
+    )
+    ranks = np.asarray(non_dominated_sort(fit))
+    np.testing.assert_array_equal(ranks, [0, 0, 0, 1, 1, 2])
+
+
+def test_crowding_distance_boundaries_inf():
+    fit = jnp.asarray([[0.0, 4.0], [1.0, 2.0], [2.0, 1.0], [4.0, 0.0]])
+    d = np.asarray(crowding_distance(fit))
+    assert np.isinf(d[0]) and np.isinf(d[3])
+    # inner: (2-0)/4 + (4-1)/4 = 1.25 ; (4-1)/4 + (2-0)/4 = 1.25
+    np.testing.assert_allclose(d[1], 1.25, rtol=1e-5)
+    np.testing.assert_allclose(d[2], 1.25, rtol=1e-5)
+
+
+def test_non_dominate_selection_keeps_first_front():
+    fit = jnp.asarray(
+        [[1.0, 5.0], [2.0, 3.0], [4.0, 1.0], [2.0, 6.0], [3.0, 3.5], [5.0, 5.0]]
+    )
+    pop = jnp.arange(6, dtype=jnp.float32)[:, None]
+    sel_pop, sel_fit = non_dominate(pop, fit, 3)
+    assert set(np.asarray(sel_pop)[:, 0].tolist()) == {0.0, 1.0, 2.0}

@@ -1,0 +1,115 @@
+"""MO benchmark problem sanity tests (reference: tests/test_classic_problems
+style — known optima / front membership)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.problems.numerical import (
+    DTLZ1,
+    DTLZ2,
+    DTLZ3,
+    DTLZ4,
+    DTLZ5,
+    DTLZ6,
+    DTLZ7,
+    ZDT1,
+    ZDT2,
+    ZDT3,
+    ZDT4,
+    ZDT6,
+)
+
+
+@pytest.mark.parametrize("cls", [ZDT1, ZDT2, ZDT4, ZDT6])
+def test_zdt_optimal_points_on_front(cls):
+    prob = cls()
+    # optimum: x1 free, rest 0 → g = 1
+    pop = jnp.zeros((8, prob.n_dim)).at[:, 0].set(jnp.linspace(0.05, 0.95, 8))
+    fit, _ = prob.evaluate(None, pop)
+    pf = prob.pf()
+    assert pf.shape[1] == 2
+    # each evaluated optimal point should lie close to the front set
+    d = jnp.min(
+        jnp.linalg.norm(fit[:, None, :] - pf[None, :, :], axis=-1), axis=1
+    )
+    assert float(jnp.max(d)) < 0.15
+
+
+def test_zdt3_front_is_nondominated_curve_subset():
+    # ZDT3's front is disconnected: g=1 points are on the curve but only the
+    # non-dominated segments are in pf()
+    prob = ZDT3()
+    pf = prob.pf()
+    x = pf[:, 0]
+    expected_f2 = 1.0 - jnp.sqrt(x) - x * jnp.sin(10.0 * jnp.pi * x)
+    np.testing.assert_allclose(np.asarray(pf[:, 1]), np.asarray(expected_f2), atol=1e-5)
+    from evox_tpu.operators.selection.non_dominate import non_dominated_sort
+
+    assert int(jnp.max(non_dominated_sort(pf))) == 0
+
+
+@pytest.mark.parametrize("cls", [DTLZ1, DTLZ2, DTLZ3, DTLZ4, DTLZ5, DTLZ6, DTLZ7])
+def test_dtlz_shapes_and_pf(cls):
+    m = 3
+    prob = cls(m=m)
+    pop = jax.random.uniform(jax.random.PRNGKey(0), (10, prob.d))
+    fit, _ = prob.evaluate(None, pop)
+    assert fit.shape == (10, m)
+    assert bool(jnp.all(jnp.isfinite(fit)))
+    pf = prob.pf()
+    assert pf.shape[1] == m
+    assert bool(jnp.all(jnp.isfinite(pf)))
+
+
+def test_dtlz2_optimum_is_sphere():
+    m = 3
+    prob = DTLZ2(m=m)
+    # x_m block at 0.5 -> g = 0 -> f on the unit sphere
+    pop = jax.random.uniform(jax.random.PRNGKey(1), (16, prob.d))
+    pop = pop.at[:, m - 1 :].set(0.5)
+    fit, _ = prob.evaluate(None, pop)
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(fit, axis=1)), 1.0, atol=1e-5
+    )
+
+
+def test_dtlz1_optimum_plane():
+    m = 3
+    prob = DTLZ1(m=m)
+    pop = jax.random.uniform(jax.random.PRNGKey(2), (16, prob.d))
+    pop = pop.at[:, m - 1 :].set(0.5)
+    fit, _ = prob.evaluate(None, pop)
+    np.testing.assert_allclose(np.asarray(jnp.sum(fit, axis=1)), 0.5, atol=1e-5)
+
+
+from evox_tpu.problems.numerical import (
+    LSMOP1, LSMOP2, LSMOP3, LSMOP4, LSMOP5, LSMOP6, LSMOP7, LSMOP8, LSMOP9,
+)
+
+
+@pytest.mark.parametrize(
+    "cls", [LSMOP1, LSMOP2, LSMOP3, LSMOP4, LSMOP5, LSMOP6, LSMOP7, LSMOP8, LSMOP9]
+)
+def test_lsmop_shapes_and_finiteness(cls):
+    prob = cls(m=3, d=60)
+    lb, ub = prob.bounds()
+    pop = jax.random.uniform(jax.random.PRNGKey(3), (12, 60)) * (ub - lb) + lb
+    fit, _ = prob.evaluate(None, pop)
+    assert fit.shape == (12, 3)
+    assert bool(jnp.all(jnp.isfinite(fit)))
+    pf = prob.pf()
+    assert pf.shape[1] == 3
+
+
+def test_lsmop1_optimum_on_simplex():
+    prob = LSMOP1(m=3, d=60)
+    # optimum: distance vars such that linked value = 0 -> x_s = 10*x1/scale
+    n, m, d = 6, 3, 60
+    pop = jax.random.uniform(jax.random.PRNGKey(5), (n, d))
+    i = jnp.arange(m, d + 1, dtype=jnp.float32)
+    scale = 1.0 + i / d
+    pop = pop.at[:, m - 1:].set(10.0 * pop[:, :1] / scale)
+    fit, _ = prob.evaluate(None, pop)
+    np.testing.assert_allclose(np.asarray(jnp.sum(fit, axis=1)), 1.0, atol=1e-4)
