@@ -1,0 +1,193 @@
+"""Pure-JAX classic-control environments.
+
+The reference delegates on-device RL rollouts to Brax and host rollouts to
+Gym/EnvPool (reference src/evox/problems/neuroevolution/reinforcement_
+learning/{brax,gym,env_pool}.py). Brax is not available in this build, so
+these environments provide the fully-on-device rollout workload natively:
+each is a pure ``(reset, step)`` pair over a small pytree state — vmap
+across (pop × episodes) batches them into one big elementwise program that
+XLA fuses and shards over the ``pop`` mesh axis with zero host involvement.
+
+Dynamics follow the standard OpenAI-Gym formulations (CartPole-v1,
+Pendulum-v1, MountainCarContinuous-v0, Acrobot-v1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EnvSpec(NamedTuple):
+    reset: Callable  # (key) -> state
+    obs: Callable  # (state) -> observation (obs_dim,)
+    step: Callable  # (state, action) -> (state, reward, done)
+    obs_dim: int
+    act_dim: int
+    discrete: bool
+    max_steps: int
+
+
+# --------------------------------------------------------------------- cartpole
+
+def cartpole(max_steps: int = 500) -> EnvSpec:
+    gravity, masscart, masspole = 9.8, 1.0, 0.1
+    total_mass = masscart + masspole
+    length = 0.5
+    polemass_length = masspole * length
+    force_mag = 10.0
+    tau = 0.02
+    theta_limit = 12 * 2 * jnp.pi / 360
+    x_limit = 2.4
+
+    def reset(key):
+        return jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+
+    def obs(s):
+        return s
+
+    def step(s, action):
+        # action: logits (2,) -> force direction
+        force = jnp.where(action[1] > action[0], force_mag, -force_mag)
+        x, x_dot, theta, theta_dot = s
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - masspole * costheta**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        theta = theta + tau * theta_dot
+        theta_dot = theta_dot + tau * thetaacc
+        s = jnp.stack([x, x_dot, theta, theta_dot])
+        done = (
+            (jnp.abs(x) > x_limit) | (jnp.abs(theta) > theta_limit)
+        )
+        return s, 1.0, done
+
+    return EnvSpec(reset, obs, step, 4, 2, True, max_steps)
+
+
+# --------------------------------------------------------------------- pendulum
+
+def pendulum(max_steps: int = 200) -> EnvSpec:
+    max_speed, max_torque = 8.0, 2.0
+    dt, g, m, l = 0.05, 10.0, 1.0, 1.0
+
+    def reset(key):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), minval=-jnp.pi, maxval=jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), minval=-1.0, maxval=1.0)
+        return jnp.stack([theta, theta_dot])
+
+    def obs(s):
+        return jnp.stack([jnp.cos(s[0]), jnp.sin(s[0]), s[1]])
+
+    def step(s, action):
+        theta, theta_dot = s
+        u = jnp.clip(action[0], -max_torque, max_torque)
+        norm_theta = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        cost = norm_theta**2 + 0.1 * theta_dot**2 + 0.001 * u**2
+        theta_dot = theta_dot + (
+            3.0 * g / (2.0 * l) * jnp.sin(theta) + 3.0 / (m * l**2) * u
+        ) * dt
+        theta_dot = jnp.clip(theta_dot, -max_speed, max_speed)
+        theta = theta + theta_dot * dt
+        return jnp.stack([theta, theta_dot]), -cost, jnp.asarray(False)
+
+    return EnvSpec(reset, obs, step, 3, 1, False, max_steps)
+
+
+# ----------------------------------------------------------------- mountain car
+
+def mountain_car(max_steps: int = 999) -> EnvSpec:
+    power = 0.0015
+
+    def reset(key):
+        pos = jax.random.uniform(key, (), minval=-0.6, maxval=-0.4)
+        return jnp.stack([pos, 0.0])
+
+    def obs(s):
+        return s
+
+    def step(s, action):
+        pos, vel = s
+        force = jnp.clip(action[0], -1.0, 1.0)
+        vel = vel + force * power - 0.0025 * jnp.cos(3.0 * pos)
+        vel = jnp.clip(vel, -0.07, 0.07)
+        pos = jnp.clip(pos + vel, -1.2, 0.6)
+        vel = jnp.where((pos <= -1.2) & (vel < 0), 0.0, vel)
+        done = pos >= 0.45
+        reward = jnp.where(done, 100.0, 0.0) - 0.1 * force**2
+        return jnp.stack([pos, vel]), reward, done
+
+    return EnvSpec(reset, obs, step, 2, 1, False, max_steps)
+
+
+# -------------------------------------------------------------------- acrobot
+
+def acrobot(max_steps: int = 500) -> EnvSpec:
+    dt = 0.2
+    l1 = l2 = m1 = m2 = 1.0
+    lc1 = lc2 = 0.5
+    I1 = I2 = 1.0
+    g = 9.8
+
+    def reset(key):
+        return jax.random.uniform(key, (4,), minval=-0.1, maxval=0.1)
+
+    def obs(s):
+        t1, t2, td1, td2 = s
+        return jnp.stack(
+            [jnp.cos(t1), jnp.sin(t1), jnp.cos(t2), jnp.sin(t2), td1, td2]
+        )
+
+    def step(s, action):
+        torque = jnp.clip(
+            jnp.argmax(action).astype(jnp.float32) - 1.0, -1.0, 1.0
+        )
+        t1, t2, td1, td2 = s
+        d1 = (
+            m1 * lc1**2
+            + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(t2))
+            + I1
+            + I2
+        )
+        d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(t2)) + I2
+        phi2 = m2 * lc2 * g * jnp.cos(t1 + t2 - jnp.pi / 2.0)
+        phi1 = (
+            -m2 * l1 * lc2 * td2**2 * jnp.sin(t2)
+            - 2 * m2 * l1 * lc2 * td2 * td1 * jnp.sin(t2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(t1 - jnp.pi / 2.0)
+            + phi2
+        )
+        tdd2 = (
+            torque + d2 / d1 * phi1 - m2 * l1 * lc2 * td1**2 * jnp.sin(t2) - phi2
+        ) / (m2 * lc2**2 + I2 - d2**2 / d1)
+        tdd1 = -(d2 * tdd2 + phi1) / d1
+        td1 = jnp.clip(td1 + dt * tdd1, -4 * jnp.pi, 4 * jnp.pi)
+        td2 = jnp.clip(td2 + dt * tdd2, -9 * jnp.pi, 9 * jnp.pi)
+        t1 = t1 + dt * td1
+        t2 = t2 + dt * td2
+        done = -jnp.cos(t1) - jnp.cos(t2 + t1) > 1.0
+        reward = jnp.where(done, 0.0, -1.0)
+        return jnp.stack([t1, t2, td1, td2]), reward, done
+
+    return EnvSpec(reset, obs, step, 6, 3, True, max_steps)
+
+
+ENVS = {
+    "cartpole": cartpole,
+    "pendulum": pendulum,
+    "mountain_car": mountain_car,
+    "acrobot": acrobot,
+}
+
+
+def make(name: str, **kwargs) -> EnvSpec:
+    if name not in ENVS:
+        raise ValueError(f"unknown env {name!r}; options: {sorted(ENVS)}")
+    return ENVS[name](**kwargs)

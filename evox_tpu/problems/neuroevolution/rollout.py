@@ -1,0 +1,164 @@
+"""On-device policy-rollout problem — the neuroevolution engine.
+
+Mirrors the reference's Brax problem structure (reference src/evox/problems/
+neuroevolution/reinforcement_learning/brax.py:45-97: double-vmapped policy
+over (pop, episodes), ``lax.while_loop`` episode loop stepping all envs until
+everyone is done or ``max_episode_length``, reward masked by done,
+``reduce_fn`` over episodes) — but generalized over any pure ``EnvSpec``
+(our JAX control envs, or Brax via the adapter).
+
+TPU-first: the entire evaluation is one jit region; under the workflow mesh
+the pop axis of the weight batch is sharded, so each chip rolls out only its
+population shard — the north-star workload shape (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.problem import Problem
+from .control.envs import EnvSpec
+
+
+class PolicyRolloutProblem(Problem):
+    """Evaluate a population of policy parameters by environment rollouts.
+
+    Args:
+        policy: ``(params, obs) -> action`` pure function (e.g.
+            ``model.apply`` of a flax MLP).
+        env: an :class:`EnvSpec`.
+        num_episodes: episodes per individual; fitness = ``reduce_fn`` over
+            episode returns.
+        max_episode_length: cap on environment steps (defaults to the env's).
+        reduce_fn: e.g. ``jnp.mean`` (default) over the episode axis.
+        stochastic_reset: draw fresh episode seeds every evaluation (the
+            reference's behavior); set False for a fixed evaluation seed
+            (lower-variance ES gradients).
+    """
+
+    def __init__(
+        self,
+        policy: Callable,
+        env: EnvSpec,
+        num_episodes: int = 4,
+        max_episode_length: Optional[int] = None,
+        reduce_fn: Callable = jnp.mean,
+        stochastic_reset: bool = True,
+    ):
+        self.policy = policy
+        self.env = env
+        self.num_episodes = num_episodes
+        self.max_len = max_episode_length or env.max_steps
+        self.reduce_fn = reduce_fn
+        self.stochastic_reset = stochastic_reset
+
+    def init(self, key=None):
+        return key if key is not None else jax.random.PRNGKey(0)
+
+    def evaluate(self, state: jax.Array, pop: Any) -> Tuple[jax.Array, jax.Array]:
+        key = state
+        if self.stochastic_reset:
+            key, k_eps = jax.random.split(key)
+        else:
+            k_eps = jax.random.fold_in(key, 0)
+        pop_size = jax.tree.leaves(pop)[0].shape[0]
+        ep_keys = jax.random.split(k_eps, self.num_episodes)
+
+        # env state batch: (pop, episodes, ...) — same episode seeds across
+        # the population for common random numbers
+        def reset_all(k):
+            return self.env.reset(k)
+
+        env_state0 = jax.vmap(reset_all)(ep_keys)  # (ep, ...)
+        env_state0 = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (pop_size,) + x.shape), env_state0
+        )  # (pop, ep, ...)
+
+        batched_policy = jax.vmap(  # over episodes
+            jax.vmap(self.policy, in_axes=(None, 0)), in_axes=(0, 0)
+        )  # params: (pop,...), obs: (pop, ep, obs_dim)
+
+        def cond(carry):
+            t, _, done, _ = carry
+            return (t < self.max_len) & ~jnp.all(done)
+
+        def body(carry):
+            t, env_state, done, total = carry
+            o = jax.vmap(jax.vmap(self.env.obs))(env_state)
+            actions = batched_policy(pop, o)
+            new_state, reward, step_done = jax.vmap(jax.vmap(self.env.step))(
+                env_state, actions
+            )
+            total = total + jnp.where(done, 0.0, reward)
+            # freeze finished episodes' states so the loop is a no-op there
+            env_state = jax.tree.map(
+                lambda old, new: jnp.where(
+                    done.reshape(done.shape + (1,) * (new.ndim - 2)), old, new
+                ),
+                env_state,
+                new_state,
+            )
+            return t + 1, env_state, done | step_done, total
+
+        done0 = jnp.zeros((pop_size, self.num_episodes), dtype=bool)
+        total0 = jnp.zeros((pop_size, self.num_episodes))
+        _, _, _, total = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), env_state0, done0, total0)
+        )
+        fitness = self.reduce_fn(total, axis=-1)
+        return fitness, key
+
+
+class CapEpisode:
+    """Adaptive episode-length cap (reference gym.py:267-281): track the mean
+    episode length and cap rollouts at twice that — pure pytree state."""
+
+    def __init__(self, init_cap: int = 100):
+        self.init_cap = init_cap
+
+    def init(self):
+        return jnp.asarray(self.init_cap, dtype=jnp.int32)
+
+    def update(self, cap: jax.Array, episode_lengths: jax.Array) -> jax.Array:
+        return jnp.maximum(
+            (2.0 * jnp.mean(episode_lengths)).astype(jnp.int32), 1
+        )
+
+    def get(self, cap: jax.Array) -> jax.Array:
+        return cap
+
+
+class ObsNormalizer:
+    """Running observation statistics (reference gym.py:20-56 ``Normalizer``)
+    as a pure pytree: ``state = (count, mean, m2)``."""
+
+    def __init__(self, obs_dim: int, clip: float = 10.0):
+        self.obs_dim = obs_dim
+        self.clip = clip
+
+    def init(self):
+        return (
+            jnp.zeros(()),
+            jnp.zeros((self.obs_dim,)),
+            jnp.ones((self.obs_dim,)),
+        )
+
+    def update(self, state, obs_batch: jax.Array):
+        count, mean, m2 = state
+        b = obs_batch.reshape(-1, self.obs_dim)
+        n = b.shape[0]
+        new_count = count + n
+        delta = jnp.mean(b, axis=0) - mean
+        new_mean = mean + delta * n / new_count
+        new_m2 = m2 + jnp.sum((b - mean) * (b - new_mean), axis=0)
+        return (new_count, new_mean, new_m2)
+
+    def normalize(self, state, obs: jax.Array) -> jax.Array:
+        count, mean, m2 = state
+        var = jnp.where(count > 1, m2 / jnp.maximum(count - 1, 1.0), 1.0)
+        return jnp.clip(
+            (obs - mean) / jnp.sqrt(var + 1e-8), -self.clip, self.clip
+        )
