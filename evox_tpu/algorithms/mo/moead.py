@@ -58,7 +58,9 @@ class MOEAD(Algorithm):
         dist = pairwise_euclidean_dist(w, w)
         self.neighbors = jnp.argsort(dist, axis=1)[:, : self.T]  # (n, T)
         self.agg = AggregationFunction(aggregate_op)
-        self.nr = max_replace  # replacement cap per offspring (MOEA/D's n_r)
+        # replacement cap per offspring (MOEA/D's n_r); clamp to the
+        # neighborhood size so [:, -nr] never indexes out of bounds when T < nr
+        self.nr = min(max_replace, self.T)
 
     def init(self, key: jax.Array) -> MOEADState:
         key, k = jax.random.split(key)

@@ -18,13 +18,23 @@ from ...operators.selection.basic import tournament
 from .common import GAMOAlgorithm, MOState
 
 
-def spea2_fitness(fit: jax.Array) -> jax.Array:
+def _masked_dist(fit: jax.Array) -> jax.Array:
+    """Pairwise distances with an inf diagonal. Masked with where(): eye*inf
+    would put 0*inf = NaN off-diagonal."""
+    n = fit.shape[0]
+    return jnp.where(
+        jnp.eye(n, dtype=bool), jnp.inf, pairwise_euclidean_dist(fit, fit)
+    )
+
+
+def spea2_fitness(fit: jax.Array, dist: jax.Array = None) -> jax.Array:
     """Raw strength fitness + k-NN density (lower = better)."""
     n = fit.shape[0]
     dom = dominate_relation(fit, fit)  # i dominates j
     strength = jnp.sum(dom, axis=1).astype(jnp.float32)  # S(i)
     raw = jnp.sum(jnp.where(dom, strength[:, None], 0.0), axis=0)  # R(j)
-    dist = pairwise_euclidean_dist(fit, fit) + jnp.eye(n) * jnp.inf
+    if dist is None:
+        dist = _masked_dist(fit)
     import math
 
     k = max(1, int(math.sqrt(n)))
@@ -38,9 +48,8 @@ class SPEA2(GAMOAlgorithm):
         return tournament(key, state.population, spea2_fitness(state.fitness))
 
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
-        score = spea2_fitness(fit)
-        n = fit.shape[0]
-        dist = pairwise_euclidean_dist(fit, fit) + jnp.eye(n) * jnp.inf
+        dist = _masked_dist(fit)
+        score = spea2_fitness(fit, dist)
         dsort = jnp.sort(dist, axis=1)  # each row: ascending k-NN distances
         # order: non-dominated first (score < 1), then by score; ties by
         # larger nearest-neighbor distances (less crowded first)

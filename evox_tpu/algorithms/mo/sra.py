@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from ...utils.common import pairwise_euclidean_dist
 from .common import GAMOAlgorithm, MOState
-from .ibea import _eps_indicator_matrix
+from .ibea import ibea_fitness
 
 
 def _sde_density(fit: jax.Array) -> jax.Array:
@@ -23,7 +23,8 @@ def _sde_density(fit: jax.Array) -> jax.Array:
     comparison point up to at least this point's objectives."""
     shifted = jnp.maximum(fit[None, :, :], fit[:, None, :])  # (i, j, m)
     d = jnp.linalg.norm(shifted - fit[:, None, :], axis=-1)
-    d = d + jnp.eye(fit.shape[0]) * jnp.inf
+    # mask the diagonal with where(): eye*inf would put 0*inf = NaN off-diagonal
+    d = jnp.where(jnp.eye(fit.shape[0], dtype=bool), jnp.inf, d)
     return jnp.min(d, axis=1)  # nearest shifted neighbor (larger = sparser)
 
 
@@ -35,9 +36,9 @@ class SRA(GAMOAlgorithm):
 
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
         n = fit.shape[0]
-        I = _eps_indicator_matrix(fit)
-        c = jnp.maximum(jnp.max(jnp.abs(I)), 1e-12)
-        i_eps = jnp.sum(-jnp.exp(-I / (c * 0.05)), axis=0) + 1.0  # lower=better
+        # IBEA exponential eps fitness is higher=better; negate so both
+        # indicators are lower=better for the comparison below
+        i_eps = -ibea_fitness(fit, 0.05)
         sde = -_sde_density(fit)  # lower = better (sparser preferred)
 
         key = jax.random.fold_in(state.key, 7)

@@ -71,3 +71,46 @@ def test_rvea_dtlz2_igd():
 def test_nsga3_dtlz2_igd():
     algo = NSGA3(LB, UB, n_objs=M, pop_size=100)
     assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.15
+
+
+def test_spea2_fitness_finite():
+    # regression: eye*inf put 0*inf = NaN off-diagonal, making every score NaN
+    from evox_tpu.algorithms.mo.spea2 import spea2_fitness
+
+    fit = jax.random.uniform(jax.random.PRNGKey(0), (32, 3))
+    assert bool(jnp.isfinite(spea2_fitness(fit)).all())
+
+
+def test_sde_density_finite():
+    from evox_tpu.algorithms.mo.sra import _sde_density
+
+    fit = jax.random.uniform(jax.random.PRNGKey(1), (32, 3))
+    d = _sde_density(fit)
+    assert bool(jnp.isfinite(d).all())
+    # dominated points legitimately get 0 (shift collapses onto them)
+    assert bool((d >= 0).all())
+
+
+def test_moead_tiny_pop_nr_clamp():
+    # regression: nr > T statically indexed out of bounds for tiny pops
+    algo = MOEAD(jnp.zeros(4), jnp.ones(4), n_objs=2, pop_size=8)
+    wf = StdWorkflow(algo, ZDT1(n_dim=4))
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.run(state, 3)
+    assert bool(jnp.isfinite(state.algo.fitness).all())
+
+
+def test_spea2_zdt1_igd():
+    zdt_dim = 12
+    algo = SPEA2(jnp.zeros(zdt_dim), jnp.ones(zdt_dim), n_objs=2, pop_size=100)
+    assert _igd_after(algo, ZDT1(n_dim=zdt_dim), 100) < 0.15
+
+
+def test_sra_dtlz2_igd():
+    algo = SRA(LB, UB, n_objs=M, pop_size=100)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.4
+
+
+def test_lmocso_dtlz2_igd():
+    algo = LMOCSO(LB, UB, n_objs=M, pop_size=100, max_gen=100)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
