@@ -13,7 +13,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...utils.common import pairwise_euclidean_dist
 from .common import GAMOAlgorithm, MOState
 from .ibea import ibea_fitness
 
