@@ -1,3 +1,4 @@
 from . import numerical
+from . import neuroevolution
 
-__all__ = ["numerical"]
+__all__ = ["numerical", "neuroevolution"]

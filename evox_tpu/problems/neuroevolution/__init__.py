@@ -1,0 +1,12 @@
+from .rollout import CapEpisode, ObsNormalizer, PolicyRolloutProblem, RolloutState
+from .policy import mlp_policy
+from .control import envs
+
+__all__ = [
+    "CapEpisode",
+    "ObsNormalizer",
+    "PolicyRolloutProblem",
+    "RolloutState",
+    "mlp_policy",
+    "envs",
+]

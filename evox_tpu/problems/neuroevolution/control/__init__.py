@@ -1,0 +1,3 @@
+from . import envs
+from .envs import EnvSpec, make
+

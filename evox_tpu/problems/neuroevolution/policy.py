@@ -1,0 +1,50 @@
+"""Tiny pure-JAX policy networks for neuroevolution.
+
+The reference's examples pair its rollout problems with user-supplied flax
+modules; these helpers give the same ergonomics with zero dependencies: an
+``(init_params, apply)`` pair whose params form an ordinary pytree, ready for
+:class:`~evox_tpu.utils.TreeAndVector` and the workflow's ``pop_transforms``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_policy(
+    layer_sizes: Sequence[int],
+    activation: Callable = jnp.tanh,
+    final_activation: Callable | None = None,
+) -> Tuple[Callable, Callable]:
+    """Build an MLP ``(init_params, apply)`` pair.
+
+    ``init_params(key) -> params`` initializes Lecun-normal weights;
+    ``apply(params, obs) -> action`` is pure and vmap/jit friendly.
+    """
+    sizes = tuple(int(s) for s in layer_sizes)
+    if len(sizes) < 2:
+        raise ValueError("layer_sizes needs at least (in, out)")
+
+    def init_params(key: jax.Array):
+        params = []
+        for k, (fan_in, fan_out) in zip(
+            jax.random.split(key, len(sizes) - 1), zip(sizes[:-1], sizes[1:])
+        ):
+            w = jax.random.normal(k, (fan_in, fan_out)) / jnp.sqrt(fan_in)
+            params.append({"w": w, "b": jnp.zeros((fan_out,))})
+        return params
+
+    def apply(params, obs: jax.Array) -> jax.Array:
+        h = obs
+        for i, layer in enumerate(params):
+            h = h @ layer["w"] + layer["b"]
+            if i < len(params) - 1:
+                h = activation(h)
+            elif final_activation is not None:
+                h = final_activation(h)
+        return h
+
+    return init_params, apply

@@ -5,7 +5,18 @@ neuroevolution/reinforcement_learning/brax.py:45-97: double-vmapped policy
 over (pop, episodes), ``lax.while_loop`` episode loop stepping all envs until
 everyone is done or ``max_episode_length``, reward masked by done,
 ``reduce_fn`` over episodes) — but generalized over any pure ``EnvSpec``
-(our JAX control envs, or Brax via the adapter).
+(our JAX control envs, or any external pure-JAX physics env wrapped into a
+``(reset, obs, step)`` triple).
+
+The reference's host-side rollout helpers are re-expressed as on-device
+pytree state threaded through ``evaluate``:
+
+- :class:`CapEpisode` (reference gym.py:267-281) — the episode-length cap
+  becomes a *traced* while_loop bound updated from the measured mean episode
+  length, so later generations stop early once policies die fast.
+- :class:`ObsNormalizer` (reference gym.py:20-56) — running observation
+  statistics; observations are normalized with the stats at evaluation start
+  and the moments observed during the rollout are merged afterwards.
 
 TPU-first: the entire evaluation is one jit region; under the workflow mesh
 the pop axis of the weight batch is sharded, so each chip rolls out only its
@@ -14,7 +25,7 @@ population shard — the north-star workload shape (SURVEY.md §6).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -23,109 +34,19 @@ from ...core.problem import Problem
 from .control.envs import EnvSpec
 
 
-class PolicyRolloutProblem(Problem):
-    """Evaluate a population of policy parameters by environment rollouts.
-
-    Args:
-        policy: ``(params, obs) -> action`` pure function (e.g.
-            ``model.apply`` of a flax MLP).
-        env: an :class:`EnvSpec`.
-        num_episodes: episodes per individual; fitness = ``reduce_fn`` over
-            episode returns.
-        max_episode_length: cap on environment steps (defaults to the env's).
-        reduce_fn: e.g. ``jnp.mean`` (default) over the episode axis.
-        stochastic_reset: draw fresh episode seeds every evaluation (the
-            reference's behavior); set False for a fixed evaluation seed
-            (lower-variance ES gradients).
-    """
-
-    def __init__(
-        self,
-        policy: Callable,
-        env: EnvSpec,
-        num_episodes: int = 4,
-        max_episode_length: Optional[int] = None,
-        reduce_fn: Callable = jnp.mean,
-        stochastic_reset: bool = True,
-    ):
-        self.policy = policy
-        self.env = env
-        self.num_episodes = num_episodes
-        self.max_len = max_episode_length or env.max_steps
-        self.reduce_fn = reduce_fn
-        self.stochastic_reset = stochastic_reset
-
-    def init(self, key=None):
-        return key if key is not None else jax.random.PRNGKey(0)
-
-    def evaluate(self, state: jax.Array, pop: Any) -> Tuple[jax.Array, jax.Array]:
-        key = state
-        if self.stochastic_reset:
-            key, k_eps = jax.random.split(key)
-        else:
-            k_eps = jax.random.fold_in(key, 0)
-        pop_size = jax.tree.leaves(pop)[0].shape[0]
-        ep_keys = jax.random.split(k_eps, self.num_episodes)
-
-        # env state batch: (pop, episodes, ...) — same episode seeds across
-        # the population for common random numbers
-        def reset_all(k):
-            return self.env.reset(k)
-
-        env_state0 = jax.vmap(reset_all)(ep_keys)  # (ep, ...)
-        env_state0 = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (pop_size,) + x.shape), env_state0
-        )  # (pop, ep, ...)
-
-        batched_policy = jax.vmap(  # over episodes
-            jax.vmap(self.policy, in_axes=(None, 0)), in_axes=(0, 0)
-        )  # params: (pop,...), obs: (pop, ep, obs_dim)
-
-        def cond(carry):
-            t, _, done, _ = carry
-            return (t < self.max_len) & ~jnp.all(done)
-
-        def body(carry):
-            t, env_state, done, total = carry
-            o = jax.vmap(jax.vmap(self.env.obs))(env_state)
-            actions = batched_policy(pop, o)
-            new_state, reward, step_done = jax.vmap(jax.vmap(self.env.step))(
-                env_state, actions
-            )
-            total = total + jnp.where(done, 0.0, reward)
-            # freeze finished episodes' states so the loop is a no-op there
-            env_state = jax.tree.map(
-                lambda old, new: jnp.where(
-                    done.reshape(done.shape + (1,) * (new.ndim - 2)), old, new
-                ),
-                env_state,
-                new_state,
-            )
-            return t + 1, env_state, done | step_done, total
-
-        done0 = jnp.zeros((pop_size, self.num_episodes), dtype=bool)
-        total0 = jnp.zeros((pop_size, self.num_episodes))
-        _, _, _, total = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), env_state0, done0, total0)
-        )
-        fitness = self.reduce_fn(total, axis=-1)
-        return fitness, key
-
-
 class CapEpisode:
-    """Adaptive episode-length cap (reference gym.py:267-281): track the mean
-    episode length and cap rollouts at twice that — pure pytree state."""
+    """Adaptive episode-length cap (reference gym.py:267-281): cap rollouts
+    at twice the measured mean episode length — pure pytree state."""
 
     def __init__(self, init_cap: int = 100):
         self.init_cap = init_cap
 
-    def init(self):
+    def init(self) -> jax.Array:
         return jnp.asarray(self.init_cap, dtype=jnp.int32)
 
     def update(self, cap: jax.Array, episode_lengths: jax.Array) -> jax.Array:
-        return jnp.maximum(
-            (2.0 * jnp.mean(episode_lengths)).astype(jnp.int32), 1
-        )
+        del cap  # the new cap depends only on the measured lengths
+        return jnp.maximum((2.0 * jnp.mean(episode_lengths)).astype(jnp.int32), 1)
 
     def get(self, cap: jax.Array) -> jax.Array:
         return cap
@@ -143,22 +64,178 @@ class ObsNormalizer:
         return (
             jnp.zeros(()),
             jnp.zeros((self.obs_dim,)),
-            jnp.ones((self.obs_dim,)),
+            jnp.zeros((self.obs_dim,)),
         )
 
     def update(self, state, obs_batch: jax.Array):
-        count, mean, m2 = state
+        """Welford batch update from a (..., obs_dim) batch of observations."""
         b = obs_batch.reshape(-1, self.obs_dim)
         n = b.shape[0]
-        new_count = count + n
-        delta = jnp.mean(b, axis=0) - mean
-        new_mean = mean + delta * n / new_count
-        new_m2 = m2 + jnp.sum((b - mean) * (b - new_mean), axis=0)
+        return self.merge_moments(
+            state,
+            jnp.asarray(float(n)),
+            jnp.sum(b, axis=0),
+            jnp.sum(b * b, axis=0),
+        )
+
+    def merge_moments(self, state, cnt, s1, s2):
+        """Merge raw moments (count, sum, sum-of-squares) into the running
+        (count, mean, m2) state (Chan's parallel update)."""
+        count, mean, m2 = state
+        safe_cnt = jnp.maximum(cnt, 1.0)
+        b_mean = s1 / safe_cnt
+        # clamp: the raw sum-of-squares form can cancel to small negatives
+        # in f32 when |mean| >> stddev, which would NaN the sqrt downstream
+        b_m2 = jnp.maximum(s2 - safe_cnt * b_mean * b_mean, 0.0)
+        new_count = count + cnt
+        delta = b_mean - mean
+        new_mean = jnp.where(
+            cnt > 0, mean + delta * cnt / jnp.maximum(new_count, 1.0), mean
+        )
+        new_m2 = jnp.where(
+            cnt > 0,
+            m2 + b_m2 + delta * delta * count * cnt / jnp.maximum(new_count, 1.0),
+            m2,
+        )
         return (new_count, new_mean, new_m2)
 
     def normalize(self, state, obs: jax.Array) -> jax.Array:
         count, mean, m2 = state
-        var = jnp.where(count > 1, m2 / jnp.maximum(count - 1, 1.0), 1.0)
+        var = jnp.where(count > 1, jnp.maximum(m2, 0.0) / jnp.maximum(count - 1, 1.0), 1.0)
         return jnp.clip(
             (obs - mean) / jnp.sqrt(var + 1e-8), -self.clip, self.clip
         )
+
+
+class RolloutState(NamedTuple):
+    key: jax.Array
+    cap: Any  # int32 cap when CapEpisode is enabled, else None
+    norm: Any  # (count, mean, m2) when ObsNormalizer is enabled, else None
+
+
+class PolicyRolloutProblem(Problem):
+    """Evaluate a population of policy parameters by environment rollouts.
+
+    Args:
+        policy: ``(params, obs) -> action`` pure function (e.g.
+            ``apply`` from :func:`~evox_tpu.problems.neuroevolution.policy.
+            mlp_policy`, or a flax module's ``apply``).
+        env: an :class:`EnvSpec`.
+        num_episodes: episodes per individual; fitness = ``reduce_fn`` over
+            episode returns.
+        max_episode_length: cap on environment steps (defaults to the env's).
+        reduce_fn: e.g. ``jnp.mean`` (default) over the episode axis.
+        stochastic_reset: draw fresh episode seeds every evaluation (the
+            reference's behavior); set False for a fixed evaluation seed
+            (lower-variance ES gradients).
+        cap_episode: a :class:`CapEpisode` to adapt the episode-length cap
+            from the measured mean episode length across generations.
+        obs_normalizer: an :class:`ObsNormalizer`; observations are
+            normalized before the policy sees them and the running stats are
+            updated from every (not-yet-done) step of every rollout.
+    """
+
+    def __init__(
+        self,
+        policy: Callable,
+        env: EnvSpec,
+        num_episodes: int = 4,
+        max_episode_length: Optional[int] = None,
+        reduce_fn: Callable = jnp.mean,
+        stochastic_reset: bool = True,
+        cap_episode: Optional[CapEpisode] = None,
+        obs_normalizer: Optional[ObsNormalizer] = None,
+    ):
+        self.policy = policy
+        self.env = env
+        self.num_episodes = num_episodes
+        self.max_len = max_episode_length or env.max_steps
+        self.reduce_fn = reduce_fn
+        self.stochastic_reset = stochastic_reset
+        self.cap_episode = cap_episode
+        self.obs_normalizer = obs_normalizer
+
+    def init(self, key=None) -> RolloutState:
+        return RolloutState(
+            key=key if key is not None else jax.random.PRNGKey(0),
+            cap=self.cap_episode.init() if self.cap_episode else None,
+            norm=self.obs_normalizer.init() if self.obs_normalizer else None,
+        )
+
+    def evaluate(self, state: RolloutState, pop: Any) -> Tuple[jax.Array, RolloutState]:
+        key = state.key
+        if self.stochastic_reset:
+            key, k_eps = jax.random.split(key)
+        else:
+            k_eps = jax.random.fold_in(key, 0)
+        pop_size = jax.tree.leaves(pop)[0].shape[0]
+        ep_keys = jax.random.split(k_eps, self.num_episodes)
+
+        # env state batch: (pop, episodes, ...) — same episode seeds across
+        # the population for common random numbers
+        env_state0 = jax.vmap(self.env.reset)(ep_keys)  # (ep, ...)
+        env_state0 = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (pop_size,) + x.shape), env_state0
+        )  # (pop, ep, ...)
+
+        batched_policy = jax.vmap(  # over episodes
+            jax.vmap(self.policy, in_axes=(None, 0)), in_axes=(0, 0)
+        )  # params: (pop,...), obs: (pop, ep, obs_dim)
+
+        if self.cap_episode is not None:
+            max_len = jnp.minimum(
+                jnp.asarray(self.max_len, jnp.int32), self.cap_episode.get(state.cap)
+            )
+        else:
+            max_len = jnp.asarray(self.max_len, jnp.int32)
+
+        obs_dim = self.env.obs_dim
+        moments0 = (jnp.zeros(()), jnp.zeros((obs_dim,)), jnp.zeros((obs_dim,)))
+
+        def cond(carry):
+            t, _, done, _, _, _ = carry
+            return (t < max_len) & ~jnp.all(done)
+
+        def body(carry):
+            t, env_state, done, total, ep_len, moments = carry
+            o = jax.vmap(jax.vmap(self.env.obs))(env_state)
+            if self.obs_normalizer is not None:
+                cnt, s1, s2 = moments
+                live = (~done).astype(o.dtype)[..., None]  # (pop, ep, 1)
+                moments = (
+                    cnt + jnp.sum(live),
+                    s1 + jnp.sum(o * live, axis=(0, 1)),
+                    s2 + jnp.sum(o * o * live, axis=(0, 1)),
+                )
+                o = self.obs_normalizer.normalize(state.norm, o)
+            actions = batched_policy(pop, o)
+            new_state, reward, step_done = jax.vmap(jax.vmap(self.env.step))(
+                env_state, actions
+            )
+            total = total + jnp.where(done, 0.0, reward)
+            ep_len = ep_len + (~done).astype(jnp.int32)
+            # freeze finished episodes' states so the loop is a no-op there
+            env_state = jax.tree.map(
+                lambda old, new: jnp.where(
+                    done.reshape(done.shape + (1,) * (new.ndim - 2)), old, new
+                ),
+                env_state,
+                new_state,
+            )
+            return t + 1, env_state, done | step_done, total, ep_len, moments
+
+        done0 = jnp.zeros((pop_size, self.num_episodes), dtype=bool)
+        total0 = jnp.zeros((pop_size, self.num_episodes))
+        len0 = jnp.zeros((pop_size, self.num_episodes), dtype=jnp.int32)
+        _, _, _, total, ep_len, moments = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), env_state0, done0, total0, len0, moments0)
+        )
+        fitness = self.reduce_fn(total, axis=-1)
+
+        cap = state.cap
+        if self.cap_episode is not None:
+            cap = self.cap_episode.update(cap, ep_len)
+        norm = state.norm
+        if self.obs_normalizer is not None:
+            norm = self.obs_normalizer.merge_moments(norm, *moments)
+        return fitness, RolloutState(key=key, cap=cap, norm=norm)

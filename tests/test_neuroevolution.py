@@ -1,0 +1,228 @@
+"""End-to-end neuroevolution tests (reference tests/test_neuroevolution.py,
+test_envpool.py, test_gym.py): policies must actually train, the rollout
+must agree across the sharded and single-device paths, and the rollout
+helpers (CapEpisode, ObsNormalizer) must do their jobs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu import StdWorkflow
+from evox_tpu.algorithms.so.es import OpenES
+from evox_tpu.algorithms.so.pso import PSO
+from evox_tpu.core.distributed import create_mesh
+from evox_tpu.monitors import EvalMonitor
+from evox_tpu.problems.neuroevolution import (
+    CapEpisode,
+    ObsNormalizer,
+    PolicyRolloutProblem,
+    mlp_policy,
+)
+from evox_tpu.problems.neuroevolution.control import envs
+from evox_tpu.utils import TreeAndVector, rank_based_fitness
+
+
+def _cartpole_setup(hidden=8):
+    env = envs.cartpole()
+    init_params, apply = mlp_policy((env.obs_dim, hidden, env.act_dim))
+    params0 = init_params(jax.random.PRNGKey(0))
+    adapter = TreeAndVector(params0)
+    return env, apply, adapter
+
+
+def test_cartpole_policy_trains():
+    """PSO + MLP solves cartpole (reward >= 400 of max 500)."""
+    env, apply, adapter = _cartpole_setup()
+    problem = PolicyRolloutProblem(
+        apply, env, num_episodes=2, stochastic_reset=False
+    )
+    algo = PSO(
+        lb=-2.0 * jnp.ones(adapter.dim),
+        ub=2.0 * jnp.ones(adapter.dim),
+        pop_size=64,
+    )
+    monitor = EvalMonitor()
+    wf = StdWorkflow(
+        algo,
+        problem,
+        monitors=(monitor,),
+        opt_direction="max",
+        pop_transforms=(adapter.batched_to_tree,),
+    )
+    state = wf.init(jax.random.PRNGKey(42))
+    state = wf.run(state, 30)
+    best = float(monitor.get_best_fitness(state.monitors[0]))
+    assert best >= 400.0, f"cartpole best reward {best} < 400"
+
+
+def test_cartpole_openes_solves():
+    """OpenES (center-based ES + rank shaping) solves cartpole."""
+    env, apply, adapter = _cartpole_setup()
+    problem = PolicyRolloutProblem(
+        apply, env, num_episodes=2, stochastic_reset=False
+    )
+    algo = OpenES(
+        center_init=jnp.zeros(adapter.dim),
+        pop_size=128,
+        learning_rate=0.05,
+        noise_stdev=0.1,
+    )
+    monitor = EvalMonitor()
+    wf = StdWorkflow(
+        algo,
+        problem,
+        monitors=(monitor,),
+        opt_direction="max",
+        pop_transforms=(adapter.batched_to_tree,),
+        fit_transforms=(rank_based_fitness,),
+    )
+    state = wf.init(jax.random.PRNGKey(1))
+    state = wf.run(state, 15)
+    best = float(monitor.get_best_fitness(state.monitors[0]))
+    assert best >= 450.0, f"cartpole best reward {best} < 450"
+
+
+def test_pendulum_pso_improves():
+    """PSO drives pendulum swing-up from ~-1100 (random) past -500."""
+    env = envs.pendulum()
+    init_params, apply = mlp_policy((env.obs_dim, 8, env.act_dim))
+    adapter = TreeAndVector(init_params(jax.random.PRNGKey(0)))
+    problem = PolicyRolloutProblem(
+        apply, env, num_episodes=4, stochastic_reset=False
+    )
+    algo = PSO(
+        lb=-3.0 * jnp.ones(adapter.dim),
+        ub=3.0 * jnp.ones(adapter.dim),
+        pop_size=128,
+    )
+    monitor = EvalMonitor()
+    wf = StdWorkflow(
+        algo,
+        problem,
+        monitors=(monitor,),
+        opt_direction="max",
+        pop_transforms=(adapter.batched_to_tree,),
+    )
+    state = wf.init(jax.random.PRNGKey(1))
+    state = wf.run(state, 40)
+    best = float(monitor.get_best_fitness(state.monitors[0]))
+    assert best > -500.0, f"pendulum best return {best} <= -500"
+
+
+def test_rollout_sharded_matches_single_device():
+    """The sharded rollout is numerically identical to single-device."""
+    env, apply, adapter = _cartpole_setup()
+
+    def build(mesh):
+        problem = PolicyRolloutProblem(
+            apply, env, num_episodes=2, stochastic_reset=False
+        )
+        algo = PSO(
+            lb=-jnp.ones(adapter.dim), ub=jnp.ones(adapter.dim), pop_size=16
+        )
+        return StdWorkflow(
+            algo,
+            problem,
+            opt_direction="max",
+            pop_transforms=(adapter.batched_to_tree,),
+            mesh=mesh,
+        )
+
+    mesh = create_mesh()  # 8 virtual CPU devices (conftest)
+    wf_s = build(mesh)
+    wf_1 = build(None)
+    s = wf_s.init(jax.random.PRNGKey(7))
+    r = wf_1.init(jax.random.PRNGKey(7))
+    for _ in range(3):
+        s = wf_s.step(s)
+        r = wf_1.step(r)
+    np.testing.assert_allclose(
+        np.asarray(s.algo.pbest_fitness),
+        np.asarray(r.algo.pbest_fitness),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(s.algo.gbest_fitness),
+        np.asarray(r.algo.gbest_fitness),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_cap_episode_shrinks_rollout():
+    """CapEpisode caps the episode loop at 2x the measured mean length."""
+    env, apply, adapter = _cartpole_setup()
+    problem = PolicyRolloutProblem(
+        apply,
+        env,
+        num_episodes=2,
+        stochastic_reset=False,
+        cap_episode=CapEpisode(init_cap=500),
+    )
+    pstate = problem.init(jax.random.PRNGKey(0))
+    pop = adapter.batched_to_tree(
+        jax.random.normal(jax.random.PRNGKey(1), (8, adapter.dim)) * 0.01
+    )
+    fit, pstate = problem.evaluate(pstate, pop)
+    # near-random cartpole policies die in tens of steps, so the adapted cap
+    # must come down from the initial 500
+    cap = int(pstate.cap)
+    assert 1 <= cap < 500
+    fit2, pstate2 = problem.evaluate(pstate, pop)
+    # with the cap active the fitness can't exceed the cap (1 reward/step)
+    assert float(jnp.max(fit2)) <= cap
+
+
+def test_obs_normalizer_tracks_stats():
+    """ObsNormalizer accumulates running stats during rollouts and
+    normalizes what the policy sees."""
+    env, apply, adapter = _cartpole_setup()
+    norm = ObsNormalizer(env.obs_dim)
+    problem = PolicyRolloutProblem(
+        apply, env, num_episodes=2, stochastic_reset=False, obs_normalizer=norm
+    )
+    pstate = problem.init(jax.random.PRNGKey(0))
+    count0 = float(pstate.norm[0])
+    pop = adapter.batched_to_tree(
+        jax.random.normal(jax.random.PRNGKey(1), (4, adapter.dim)) * 0.01
+    )
+    _, pstate = problem.evaluate(pstate, pop)
+    count1, mean1, m2 = pstate.norm
+    assert float(count1) > count0
+    assert bool(jnp.isfinite(mean1).all()) and bool(jnp.isfinite(m2).all())
+    # normalize() output is clipped and finite
+    o = norm.normalize(pstate.norm, jnp.ones((env.obs_dim,)) * 100.0)
+    assert bool((jnp.abs(o) <= norm.clip).all())
+
+
+def test_obs_normalizer_batch_update_matches_numpy():
+    norm = ObsNormalizer(3)
+    s = norm.init()
+    rng = np.random.default_rng(0)
+    all_batches = []
+    for i in range(3):
+        b = rng.normal(size=(50, 3)) * (i + 1) + i
+        all_batches.append(b)
+        s = norm.update(s, jnp.asarray(b))
+    allb = np.concatenate(all_batches, axis=0)
+    count, mean, m2 = s
+    assert float(count) == pytest.approx(150.0)
+    np.testing.assert_allclose(np.asarray(mean), allb.mean(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(m2) / (150 - 1), allb.var(axis=0, ddof=1), rtol=1e-3
+    )
+
+
+@pytest.mark.parametrize("name", ["cartpole", "pendulum", "mountain_car", "acrobot"])
+def test_env_step_shapes(name):
+    env = envs.make(name)
+    key = jax.random.PRNGKey(0)
+    s = env.reset(key)
+    o = env.obs(s)
+    assert o.shape == (env.obs_dim,)
+    a = jnp.zeros((env.act_dim,))
+    s2, r, d = env.step(s, a)
+    assert jax.tree.structure(s2) == jax.tree.structure(s)
+    assert jnp.shape(r) == () and jnp.shape(d) == ()
