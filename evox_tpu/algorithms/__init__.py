@@ -1,11 +1,16 @@
-from . import so, mo
+from . import so, mo, containers
 from .so.pso import PSO, CSO
 from .so.es import *  # noqa: F401,F403 — full ES surface
 from .so.de import *  # noqa: F401,F403 — full DE surface
 from .mo import *  # noqa: F401,F403 — full MO surface
+from .containers import *  # noqa: F401,F403 — decomposition containers
 from .so import es as _es, de as _de
-from . import mo as _mo
+from . import mo as _mo, containers as _containers
 
-__all__ = ["so", "mo", "PSO", "CSO"] + list(_es.__all__) + list(_de.__all__) + list(
-    _mo.__all__
+__all__ = (
+    ["so", "mo", "containers", "PSO", "CSO"]
+    + list(_es.__all__)
+    + list(_de.__all__)
+    + list(_mo.__all__)
+    + list(_containers.__all__)
 )

@@ -1,0 +1,26 @@
+"""Decision-space decomposition containers.
+
+Capability parity with the reference's ``algorithms/containers`` package
+(reference src/evox/algorithms/containers/{clustered_algorithm,coevolution,
+tree_algorithm}.py) — the framework's "model-parallel" axis (SURVEY.md §2.3):
+meta-algorithms that split the decision vector into blocks and run a base
+algorithm per block.
+
+TPU-first redesign: because every algorithm's state is a typed pytree with
+``init(key) -> state``, a batch of sub-algorithm instances is simply
+``vmap(base.init)`` — no node-id bookkeeping, no ``Stateful.stack``, no
+``use_state(index=...)``; masking/indexing a sub-state is a ``tree_map``
+gather over the leading cluster axis.
+"""
+
+from .clustered import ClusteredAlgorithm, RandomMaskAlgorithm
+from .coevolution import Coevolution, VectorizedCoevolution
+from .tree import TreeAlgorithm
+
+__all__ = [
+    "ClusteredAlgorithm",
+    "RandomMaskAlgorithm",
+    "Coevolution",
+    "VectorizedCoevolution",
+    "TreeAlgorithm",
+]

@@ -1,0 +1,188 @@
+"""Clustered decomposition containers.
+
+Capability parity with the reference's ``ClusterdAlgorithm`` and
+``RandomMaskAlgorithm`` (reference src/evox/algorithms/containers/
+clustered_algorithm.py:11-72 and :74-160): split the decision vector into
+``num_clusters`` contiguous blocks and run one instance of a base algorithm
+per block; the evaluated candidate is the concatenation of all blocks.
+
+TPU-first: the cluster batch is ``vmap(base.init)`` over split keys, so the
+whole ask/tell fans out as one vmapped program — XLA sees a single fused
+kernel over a ``(clusters, pop, sub_dim)`` batch instead of a Python loop of
+small ops. Under the workflow mesh the pop axis stays sharded.
+
+Note: the reference's ``_try_change_mask`` has inverted ``lax.cond`` branches
+(clustered_algorithm.py:155-160 re-draws the mask on every generation *except*
+multiples of ``change_every``); this implementation follows the documented
+intent — re-draw every ``change_every`` generations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.algorithm import Algorithm
+from ...core.struct import PyTreeNode
+from .common import put_state, take_state
+
+
+class ClusteredAlgorithm(Algorithm):
+    """Run ``num_clusters`` copies of ``base_algorithm`` on contiguous
+    decision-variable blocks.
+
+    The base algorithm must be constructed for the *sub*-problem dimension
+    ``dim // num_clusters``; all clusters share its hyperparameters (the
+    vmap is over state, the algorithm object is static).
+    """
+
+    def __init__(self, base_algorithm: Algorithm, dim: int, num_clusters: int):
+        assert dim % num_clusters == 0, "dim must divide evenly into clusters"
+        self.base = base_algorithm
+        self.dim = dim
+        self.num_clusters = num_clusters
+        self.sub_dim = dim // num_clusters
+
+    def init(self, key: jax.Array) -> Any:
+        keys = jax.random.split(key, self.num_clusters)
+        return jax.vmap(self.base.init)(keys)
+
+    def _concat(self, sub_pops: jax.Array) -> jax.Array:
+        # (clusters, pop, sub_dim) -> (pop, clusters*sub_dim)
+        return sub_pops.transpose(1, 0, 2).reshape(sub_pops.shape[1], -1)
+
+    def init_ask(self, state: Any) -> Tuple[jax.Array, Any]:
+        sub_pops, state = jax.vmap(self.base.init_ask)(state)
+        return self._concat(sub_pops), state
+
+    def init_tell(self, state: Any, fitness: jax.Array) -> Any:
+        return jax.vmap(self.base.init_tell, in_axes=(0, None))(state, fitness)
+
+    def ask(self, state: Any) -> Tuple[jax.Array, Any]:
+        sub_pops, state = jax.vmap(self.base.ask)(state)
+        return self._concat(sub_pops), state
+
+    def tell(self, state: Any, fitness: jax.Array) -> Any:
+        # every cluster sees the full fitness of the concatenated candidates
+        return jax.vmap(self.base.tell, in_axes=(0, None))(state, fitness)
+
+
+class RandomMaskState(PyTreeNode):
+    sub_states: Any  # stacked base states, leading axis = num_clusters
+    sub_pops: jax.Array  # cached candidate block per cluster
+    active: jax.Array  # (num_active,) indices of unmasked clusters
+    count: jax.Array  # gens since mask change; -1/-2: cache seeding phases
+    key: jax.Array
+
+
+class RandomMaskAlgorithm(Algorithm):
+    """Clustered container where only a random subset of clusters evolves.
+
+    Each generation, ``num_clusters - num_mask`` randomly-chosen "active"
+    clusters ask/tell; masked clusters keep their cached candidate block and
+    frozen state. The active set is re-drawn every ``change_every``
+    generations. Mirrors reference clustered_algorithm.py:74-160.
+    """
+
+    def __init__(
+        self,
+        base_algorithm: Algorithm,
+        dim: int,
+        num_clusters: int,
+        num_mask: int = 1,
+        change_every: int = 1,
+    ):
+        assert dim % num_clusters == 0, "dim must divide evenly into clusters"
+        assert 0 < num_mask < num_clusters
+        self.base = base_algorithm
+        self.dim = dim
+        self.num_clusters = num_clusters
+        self.num_mask = num_mask
+        self.num_active = num_clusters - num_mask
+        self.change_every = change_every
+        self.sub_dim = dim // num_clusters
+
+    def init(self, key: jax.Array) -> RandomMaskState:
+        k_self, k_mask, *keys = jax.random.split(key, self.num_clusters + 2)
+        sub_states = jax.vmap(self.base.init)(jnp.stack(keys))
+        active = jax.random.choice(
+            k_mask, self.num_clusters, (self.num_active,), replace=False
+        )
+        # the steady-state ask size is discovered statically (no FLOPs)
+        ask_shape = jax.eval_shape(jax.vmap(self.base.ask), sub_states)[0].shape
+        return RandomMaskState(
+            sub_states=sub_states,
+            sub_pops=jnp.zeros(ask_shape),
+            active=active,
+            count=jnp.full((), -1, dtype=jnp.int32),  # -1: cache not yet seeded
+            key=k_self,
+        )
+
+    def _concat(self, sub_pops: jax.Array) -> jax.Array:
+        return sub_pops.transpose(1, 0, 2).reshape(sub_pops.shape[1], -1)
+
+    def init_ask(self, state: RandomMaskState) -> Tuple[jax.Array, RandomMaskState]:
+        # first generation: the base's own init protocol, every cluster
+        sub_pops, sub_states = jax.vmap(self.base.init_ask)(state.sub_states)
+        return self._concat(sub_pops), state.replace(sub_states=sub_states)
+
+    def init_tell(self, state: RandomMaskState, fitness: jax.Array) -> RandomMaskState:
+        sub_states = jax.vmap(self.base.init_tell, in_axes=(0, None))(
+            state.sub_states, fitness
+        )
+        return state.replace(sub_states=sub_states)
+
+    def _maybe_change_mask(self, state: RandomMaskState) -> RandomMaskState:
+        def redraw(s):
+            key, k = jax.random.split(s.key)
+            active = jax.random.choice(
+                k, self.num_clusters, (self.num_active,), replace=False
+            )
+            return s.replace(key=key, active=active, count=jnp.zeros((), jnp.int32))
+
+        return jax.lax.cond(
+            state.count >= self.change_every, redraw, lambda s: s, state
+        )
+
+    def ask(self, state: RandomMaskState) -> Tuple[jax.Array, RandomMaskState]:
+        def seed_cache(s):
+            # first steady generation: every cluster proposes, filling the
+            # cache that masked clusters will contribute from later
+            sub_pops, sub_states = jax.vmap(self.base.ask)(s.sub_states)
+            return s.replace(
+                sub_states=sub_states,
+                sub_pops=sub_pops,
+                count=jnp.full((), -2, dtype=jnp.int32),  # -2: tell all once
+            )
+
+        def masked_ask(s):
+            s = self._maybe_change_mask(s)
+            masked = take_state(s.sub_states, s.active)
+            active_pops, new_active = jax.vmap(self.base.ask)(masked)
+            return s.replace(
+                sub_states=put_state(s.sub_states, s.active, new_active),
+                sub_pops=s.sub_pops.at[s.active].set(active_pops),
+            )
+
+        state = jax.lax.cond(state.count < 0, seed_cache, masked_ask, state)
+        return self._concat(state.sub_pops), state
+
+    def tell(self, state: RandomMaskState, fitness: jax.Array) -> RandomMaskState:
+        def tell_all(s):
+            # the cache-seeding generation asked every cluster
+            sub_states = jax.vmap(self.base.tell, in_axes=(0, None))(
+                s.sub_states, fitness
+            )
+            return s.replace(sub_states=sub_states, count=jnp.zeros((), jnp.int32))
+
+        def tell_active(s):
+            masked = take_state(s.sub_states, s.active)
+            new_states = jax.vmap(self.base.tell, in_axes=(0, None))(masked, fitness)
+            return s.replace(
+                sub_states=put_state(s.sub_states, s.active, new_states),
+                count=s.count + 1,
+            )
+
+        return jax.lax.cond(state.count == -2, tell_all, tell_active, state)

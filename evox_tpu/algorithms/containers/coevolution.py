@@ -1,0 +1,182 @@
+"""Cooperative co-evolution containers.
+
+Capability parity with the reference's ``VectorizedCoevolution`` and
+``Coevolution`` (reference src/evox/algorithms/containers/coevolution.py:14-139
+and :140-258): the decision vector is split into ``num_subpops`` blocks, one
+base-algorithm instance per block; candidates from a block are *spliced into
+the best-so-far full decision vector* for evaluation, so each sub-algorithm
+optimizes its block in the context of the best known values of the others.
+
+- ``VectorizedCoevolution``: every block evolves every generation (the whole
+  fan-out is one vmap — evaluated pop is ``num_subpops * ask_size``).
+- ``Coevolution``: classic round-robin — one block per generation; the
+  sub-state is gathered/scattered by a traced index, replacing the
+  reference's ``use_state(..., index=...)`` machinery (module.py:16-88) with
+  two tree_maps.
+
+``random_subpop=True`` shuffles decision variables across blocks via a fixed
+permutation drawn at init (the container works in the permuted layout and
+un-permutes candidates just before evaluation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.algorithm import Algorithm
+from ...core.struct import PyTreeNode
+from .common import put_state, take_state
+
+
+class CoevolutionState(PyTreeNode):
+    sub_states: Any  # stacked base states, leading axis = num_subpops
+    best_dec: jax.Array  # (dim,) best-so-far full decision vector (permuted layout)
+    best_fit: jax.Array  # (num_subpops,) best fitness seen per block
+    coop_pops: jax.Array  # last evaluated candidates (permuted layout)
+    iter_counter: jax.Array
+    permutation: Optional[jax.Array]
+    key: jax.Array
+
+
+class _CoevolutionBase(Algorithm):
+    def __init__(
+        self,
+        base_algorithm: Algorithm,
+        dim: int,
+        num_subpops: int,
+        random_subpop: bool = False,
+    ):
+        assert dim % num_subpops == 0, "dim must divide evenly into subpops"
+        self.base = base_algorithm
+        self.dim = dim
+        self.num_subpops = num_subpops
+        self.sub_dim = dim // num_subpops
+        self.random_subpop = random_subpop
+
+    def init(self, key: jax.Array) -> CoevolutionState:
+        k_self, k_perm, *keys = jax.random.split(key, self.num_subpops + 2)
+        sub_states = jax.vmap(self.base.init)(jnp.stack(keys))
+        perm = jax.random.permutation(k_perm, self.dim) if self.random_subpop else None
+        return CoevolutionState(
+            sub_states=sub_states,
+            best_dec=jnp.zeros((self.dim,)),
+            best_fit=jnp.full((self.num_subpops,), jnp.inf),
+            coop_pops=jnp.zeros((0, self.dim)),
+            iter_counter=jnp.zeros((), dtype=jnp.int32),
+            permutation=perm,
+            key=k_self,
+        )
+
+    def _unpermute(self, pop: jax.Array, perm) -> jax.Array:
+        """Permuted (internal) layout -> problem layout for evaluation.
+
+        ``pop[:, inv_perm]`` (a gather) rather than scattering into zeros."""
+        if not self.random_subpop:
+            return pop
+        return pop[:, jnp.argsort(perm)]
+
+    def _permute(self, dec: jax.Array, perm) -> jax.Array:
+        """Problem layout -> permuted (internal) layout."""
+        if not self.random_subpop:
+            return dec
+        return dec[..., perm]
+
+    # first generation: every block proposes; row j of the evaluated pop is
+    # the concatenation of every block's row j (reference coevolution.py:56-66)
+    def init_ask(self, state: CoevolutionState) -> Tuple[jax.Array, CoevolutionState]:
+        sub_pops, sub_states = jax.vmap(self.base.init_ask)(state.sub_states)
+        pop = sub_pops.transpose(1, 0, 2).reshape(sub_pops.shape[1], self.dim)
+        return self._unpermute(pop, state.permutation), state.replace(
+            sub_states=sub_states, coop_pops=pop
+        )
+
+    def init_tell(self, state: CoevolutionState, fitness: jax.Array) -> CoevolutionState:
+        sub_states = jax.vmap(self.base.init_tell, in_axes=(0, None))(
+            state.sub_states, fitness
+        )
+        best = jnp.argmin(fitness)
+        return state.replace(
+            sub_states=sub_states,
+            best_dec=state.coop_pops[best],
+            best_fit=jnp.full((self.num_subpops,), fitness[best]),
+            coop_pops=jnp.zeros((0, self.dim)),
+        )
+
+
+class VectorizedCoevolution(_CoevolutionBase):
+    """All blocks evolve each generation (reference coevolution.py:14-139)."""
+
+    def ask(self, state: CoevolutionState) -> Tuple[jax.Array, CoevolutionState]:
+        sub_pops, sub_states = jax.vmap(self.base.ask)(state.sub_states)
+        n_sub, ask_size, _ = sub_pops.shape
+        tiled = jnp.broadcast_to(state.best_dec, (ask_size, self.dim))
+        coop = jax.vmap(
+            lambda i: jax.lax.dynamic_update_slice(
+                tiled, sub_pops[i], (0, i * self.sub_dim)
+            )
+        )(jnp.arange(n_sub)).reshape(n_sub * ask_size, self.dim)
+        return self._unpermute(coop, state.permutation), state.replace(
+            sub_states=sub_states, coop_pops=coop
+        )
+
+    def tell(self, state: CoevolutionState, fitness: jax.Array) -> CoevolutionState:
+        per_sub = fitness.reshape(self.num_subpops, -1)
+        ask_size = per_sub.shape[1]
+        sub_states = jax.vmap(self.base.tell)(state.sub_states, per_sub)
+        min_fit = jnp.min(per_sub, axis=1)  # (num_subpops,)
+        argmin = jnp.argmin(per_sub, axis=1)
+        # block i of the best row of subpop i (other blocks there equal best_dec)
+        rows = state.coop_pops.reshape(self.num_subpops, ask_size, self.dim)[
+            jnp.arange(self.num_subpops), argmin
+        ]  # (num_subpops, dim)
+        blocks = rows.reshape(self.num_subpops, self.num_subpops, self.sub_dim)[
+            jnp.arange(self.num_subpops), jnp.arange(self.num_subpops)
+        ]  # (num_subpops, sub_dim)
+        improved = min_fit < state.best_fit
+        best_blocks = jnp.where(
+            improved[:, None], blocks, state.best_dec.reshape(self.num_subpops, -1)
+        )
+        return state.replace(
+            sub_states=sub_states,
+            best_dec=best_blocks.reshape(self.dim),
+            best_fit=jnp.minimum(state.best_fit, min_fit),
+            coop_pops=jnp.zeros((0, self.dim)),
+            iter_counter=state.iter_counter + 1,
+        )
+
+
+class Coevolution(_CoevolutionBase):
+    """Round-robin: one block evolves per generation (reference
+    coevolution.py:140-258)."""
+
+    def ask(self, state: CoevolutionState) -> Tuple[jax.Array, CoevolutionState]:
+        idx = state.iter_counter % self.num_subpops
+        sub_state = take_state(state.sub_states, idx)
+        sub_pop, new_sub = self.base.ask(sub_state)
+        ask_size = sub_pop.shape[0]
+        tiled = jnp.broadcast_to(state.best_dec, (ask_size, self.dim))
+        coop = jax.vmap(
+            lambda row, block: jax.lax.dynamic_update_slice(
+                row, block, (idx * self.sub_dim,)
+            )
+        )(tiled, sub_pop)
+        return self._unpermute(coop, state.permutation), state.replace(
+            sub_states=put_state(state.sub_states, idx, new_sub), coop_pops=coop
+        )
+
+    def tell(self, state: CoevolutionState, fitness: jax.Array) -> CoevolutionState:
+        idx = state.iter_counter % self.num_subpops
+        sub_state = take_state(state.sub_states, idx)
+        new_sub = self.base.tell(sub_state, fitness)
+        best = jnp.argmin(fitness)
+        improved = fitness[best] < state.best_fit[idx]
+        return state.replace(
+            sub_states=put_state(state.sub_states, idx, new_sub),
+            best_dec=jnp.where(improved, state.coop_pops[best], state.best_dec),
+            best_fit=state.best_fit.at[idx].min(fitness[best]),
+            coop_pops=jnp.zeros((0, self.dim)),
+            iter_counter=state.iter_counter + 1,
+        )

@@ -1,0 +1,25 @@
+"""Shared helpers for the decomposition containers.
+
+A "stacked" state is whatever ``vmap(base.init)(keys)`` returns: the base
+algorithm's typed pytree state with an extra leading cluster axis on every
+leaf. These helpers gather/scatter along that axis (the reference's
+``_mask_state``/``_unmask_state``, clustered_algorithm.py:45-59, and
+``use_state(..., index=...)``, module.py:16-88, collapse to plain tree_maps
+in this design).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def take_state(stacked: Any, idx) -> Any:
+    """Gather sub-state(s) ``idx`` (int array or scalar, may be traced)."""
+    return jax.tree.map(lambda x: x[idx], stacked)
+
+
+def put_state(stacked: Any, idx, sub: Any) -> Any:
+    """Scatter ``sub`` back into position(s) ``idx`` of the stacked state."""
+    return jax.tree.map(lambda full, new: full.at[idx].set(new), stacked, sub)

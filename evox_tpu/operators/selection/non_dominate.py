@@ -22,32 +22,47 @@ from ...utils.common import dominate_relation
 INF = jnp.inf
 
 
-def non_dominated_sort(fitness: jax.Array) -> jax.Array:
+def non_dominated_sort(fitness: jax.Array, until: Optional[int] = None) -> jax.Array:
     """Pareto-rank each row of ``fitness`` (n, m); rank 0 = non-dominated.
 
-    Minimization convention.
+    Minimization convention. With ``until=k`` the peeling stops once at
+    least ``k`` individuals have been ranked — environmental selection only
+    needs fronts up to the cut, so this roughly halves the peel iterations
+    on a merged parent+offspring population. Unranked rows get the sentinel
+    rank ``n`` (worse than every real rank).
+
+    The dominance matrix is held in bfloat16 so each peel iteration is one
+    MXU matvec at half the HBM traffic of f32; front/dominator counts stay
+    exact because 0/1 values and f32 accumulation are exact in bf16 matmuls.
     """
     n = fitness.shape[0]
+    stop = n if until is None else min(until, n)
     dom = dominate_relation(fitness, fitness)  # (n, n) bool: i dominates j
-    dom_f = dom.astype(jnp.float32)
-    count = jnp.sum(dom_f, axis=0)  # how many dominate j
-    rank = jnp.zeros((n,), dtype=jnp.int32)
+    dom_bf = dom.astype(jnp.bfloat16)
+    count = jnp.sum(dom, axis=0, dtype=jnp.float32)  # how many dominate j
+    rank = jnp.full((n,), n, dtype=jnp.int32)  # sentinel: unranked
     front = count == 0.0
 
     def cond(carry):
-        _, _, front, _ = carry
-        return jnp.any(front)
+        _, _, front, _, done = carry
+        return jnp.any(front) & (done < stop)
 
     def body(carry):
-        rank, count, front, r = carry
+        rank, count, front, r, done = carry
         rank = jnp.where(front, r, rank)
+        done = done + jnp.sum(front, dtype=jnp.int32)
         front_f = front.astype(jnp.float32)
         # remove current front's domination counts in one matvec,
         # and push processed rows to -1 so they never re-enter
-        count = count - front_f @ dom_f - front_f
-        return rank, count, count == 0.0, r + 1
+        delta = jnp.matmul(
+            front.astype(jnp.bfloat16), dom_bf, preferred_element_type=jnp.float32
+        )
+        count = count - delta - front_f
+        return rank, count, count == 0.0, r + 1, done
 
-    rank, _, _, _ = jax.lax.while_loop(cond, body, (rank, count, front, jnp.int32(0)))
+    rank, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (rank, count, front, jnp.int32(0), jnp.int32(0))
+    )
     return rank
 
 
@@ -99,7 +114,7 @@ def non_dominate_indices(
         _, idx = jnp.unique(pop, axis=0, size=n, return_index=True, fill_value=jnp.nan)
         is_first = jnp.zeros((n,), dtype=bool).at[idx].set(True)
         fitness = jnp.where(is_first[:, None], fitness, INF)
-    rank = non_dominated_sort(fitness)
+    rank = non_dominated_sort(fitness, until=topk)
     # crowding ties-break only matters within the worst admitted rank
     worst_rank = jnp.sort(rank)[topk - 1]
     crowd = crowding_distance(fitness, mask=rank == worst_rank)
