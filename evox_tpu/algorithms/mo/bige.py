@@ -1,38 +1,67 @@
 """BiGE (Li, Yang & Liu 2015): bi-goal evolution — map many objectives to
 the two meta-goals (proximity, crowding degree) and run Pareto selection in
 that bi-goal space. Capability parity with reference
-src/evox/algorithms/mo/bige.py:64+."""
+src/evox/algorithms/mo/bige.py:26-142, full mechanics:
+
+- asymmetric sharing function: neighbors with better (or equal) proximity
+  count 2x/3x toward your crowding degree, radius r = 1/n^(1/m);
+- mating selection = tournament on the bi-goal non-dominated rank of the
+  *parents* (ref ask:111-120);
+- environmental selection keeps strictly-better objective-space fronts
+  outright and applies bi-goal ranking only within the cut front
+  (ref tell:126-142).
+"""
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
-from ...operators.selection.non_dominate import non_dominate
+from ...operators.selection.basic import tournament
+from ...operators.selection.non_dominate import non_dominated_sort
 from ...utils.common import pairwise_euclidean_dist
 from .common import GAMOAlgorithm, MOState
 
 
-def _bi_goals(fit: jax.Array) -> jax.Array:
+def bi_goals(fit: jax.Array, mask: jax.Array) -> jax.Array:
+    """(n, 2) [proximity, crowding degree] of the masked rows; dead rows inf.
+
+    Crowding uses the paper's asymmetric sharing: sh(a,b) =
+    (0.5 (1 + [pr_a >= pr_b] + [pr_a > pr_b]) (1 - d/r))^2 for d < r.
+    """
     n, m = fit.shape
-    fmin = jnp.min(fit, axis=0)
-    fmax = jnp.max(fit, axis=0)
-    f = (fit - fmin) / jnp.maximum(fmax - fmin, 1e-12)
-    fpr = jnp.sum(f, axis=1)  # proximity
-    # crowding degree with sharing radius r
-    r = (jnp.mean(fpr) / n) ** (1.0 / m)
+    n_live = jnp.sum(mask)
+    r = 1.0 / n_live ** (1.0 / m)
+    f = jnp.where(mask[:, None], fit, jnp.nan)
+    fmin = jnp.nanmin(f, axis=0)
+    fmax = jnp.nanmax(f, axis=0)
+    f = (f - fmin) / jnp.clip(fmax - fmin, 1e-6)
+    f = jnp.where(mask[:, None], f, float(m))
+    pr = jnp.sum(f, axis=1)
     d = pairwise_euclidean_dist(f, f)
-    sh = jnp.where(d < r, (1.0 - d / jnp.maximum(r, 1e-12)) ** 2, 0.0)
-    sh = sh - jnp.diag(jnp.diagonal(sh))
-    fcd = jnp.sqrt(jnp.sum(sh, axis=1))
-    return jnp.stack([fpr, fcd], axis=1)
+    w = 1.0 + (pr[:, None] >= pr[None, :]) + (pr[:, None] > pr[None, :])
+    sh = ((d < r) * 0.5 * (w * (1.0 - d / r))) ** 2
+    cd = jnp.sqrt(jnp.sum(sh, axis=1) - jnp.diagonal(sh))
+    bi = jnp.stack([pr, cd], axis=1)
+    return jnp.where(mask[:, None], bi, jnp.inf)
 
 
 class BiGE(GAMOAlgorithm):
-    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
-        goals = _bi_goals(fit)
-        idx = jnp.arange(fit.shape[0])
-        from ...operators.selection.non_dominate import non_dominate_indices
+    def mate(self, key: jax.Array, state: MOState) -> jax.Array:
+        all_live = jnp.ones((self.pop_size,), dtype=bool)
+        bi = bi_goals(state.fitness, all_live)
+        bi_rank = non_dominated_sort(bi)
+        return tournament(key, state.population, bi_rank.astype(jnp.float32))
 
-        order = non_dominate_indices(goals, self.pop_size)
-        return pop[order], fit[order]
+    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
+        rank = non_dominated_sort(fit)
+        order = jnp.argsort(rank)
+        rank = rank[order]
+        pop, fit = pop[order], fit[order]
+        last_rank = rank[self.pop_size]
+        # bi-goal ranking only among the cut front; safer fronts keep rank -1
+        bi = bi_goals(fit, rank == last_rank)
+        bi_rank = non_dominated_sort(bi)
+        fin = jnp.where(rank >= last_rank, bi_rank, -1)
+        idx = jnp.argsort(fin)[: self.pop_size]
+        return pop[idx], fit[idx]

@@ -5,9 +5,12 @@ from .sl_pso import SLPSOGS, SLPSOUS
 from .fips import FIPS
 from .dms_pso_el import DMSPSOEL
 from .fs_pso import FSPSO
+from .swmmpso import SwmmPSO, SwmmPSOState
 from . import topology
 
 __all__ = [
+    "SwmmPSO",
+    "SwmmPSOState",
     "PSO",
     "PSOState",
     "CSO",

@@ -132,11 +132,25 @@ class EvalMonitor(Monitor):
     def get_topk_solutions(self, mstate: EvalMonitorState):
         return mstate.topk_solution
 
+    def get_pf_mask(self, mstate: EvalMonitorState) -> jax.Array:
+        """(pf_capacity,) bool — which archive rows hold real PF members.
+        Jit-safe companion to the padded getters below."""
+        return jnp.all(jnp.isfinite(mstate.topk_fitness), axis=-1)
+
     def get_pf_fitness(self, mstate: EvalMonitorState) -> jax.Array:
+        """Pareto-archive fitness. Eagerly: sliced to the live rows. Under
+        jit (``mstate`` is traced): the full fixed-capacity buffer, with
+        dead rows inf-padded — combine with :meth:`get_pf_mask`."""
+        if isinstance(mstate.pf_count, jax.core.Tracer):
+            return mstate.topk_fitness
         n = int(mstate.pf_count)
-        return mstate.topk_fitness[:n] if n else mstate.topk_fitness[:0]
+        return mstate.topk_fitness[:n]
 
     def get_pf_solutions(self, mstate: EvalMonitorState):
+        """Pareto-archive solutions; same eager-slice / traced-padded
+        contract as :meth:`get_pf_fitness`."""
+        if isinstance(mstate.pf_count, jax.core.Tracer):
+            return mstate.topk_solution
         n = int(mstate.pf_count)
         return jax.tree.map(lambda x: x[:n], mstate.topk_solution)
 

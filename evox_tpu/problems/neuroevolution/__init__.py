@@ -1,8 +1,15 @@
-from .rollout import CapEpisode, ObsNormalizer, PolicyRolloutProblem, RolloutState
+from .rollout import (
+    CapEpisode,
+    ObsNormalizer,
+    PolicyRolloutProblem,
+    RolloutState,
+    Trajectory,
+)
 from .policy import mlp_policy
 from .control import envs
 
 __all__ = [
+    "Trajectory",
     "CapEpisode",
     "ObsNormalizer",
     "PolicyRolloutProblem",

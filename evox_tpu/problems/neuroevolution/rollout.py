@@ -107,6 +107,19 @@ class ObsNormalizer:
         )
 
 
+class Trajectory(NamedTuple):
+    """A single rollout's full trace (see :meth:`PolicyRolloutProblem.
+    visualize`). All arrays are time-major with length ``max_episode_length``;
+    steps after episode end are frozen (state repeats, reward 0, done True)."""
+
+    states: Any  # (T, ...) raw env states — whatever the env's pytree is
+    obs: jax.Array  # (T, obs_dim)
+    actions: jax.Array  # (T, act_dim)
+    rewards: jax.Array  # (T,)
+    dones: jax.Array  # (T,) bool
+    length: jax.Array  # () int32 — number of live steps
+
+
 class RolloutState(NamedTuple):
     key: jax.Array
     cap: Any  # int32 cap when CapEpisode is enabled, else None
@@ -239,3 +252,51 @@ class PolicyRolloutProblem(Problem):
         if self.obs_normalizer is not None:
             norm = self.obs_normalizer.merge_moments(norm, *moments)
         return fitness, RolloutState(key=key, cap=cap, norm=norm)
+
+    def visualize(
+        self,
+        params: Any,
+        key: Optional[jax.Array] = None,
+        state: Optional[RolloutState] = None,
+    ) -> Trajectory:
+        """Roll out ONE policy and return its full :class:`Trajectory`.
+
+        The policy-inspection analog of the reference's ``visualize``
+        (reference brax.py:99-133 renders HTML, gym.py:383-426 collects
+        frames): with pure-JAX envs there is no renderer to call, so the
+        trace itself — env states, observations, actions, rewards — is the
+        artifact; pipe it into ``vis_tools`` plots or any custom renderer.
+        Observation normalization uses the running stats in ``state`` (pass
+        the post-training problem state to see what the policy actually saw).
+        """
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        env_state0 = self.env.reset(key)
+
+        def scan_step(carry, _):
+            env_state, done = carry
+            o = self.env.obs(env_state)
+            o_in = (
+                self.obs_normalizer.normalize(state.norm, o)
+                if self.obs_normalizer is not None and state is not None
+                else o
+            )
+            action = self.policy(params, o_in)
+            new_state, reward, step_done = self.env.step(env_state, action)
+            new_state = jax.tree.map(
+                lambda old, new: jnp.where(done, old, new), env_state, new_state
+            )
+            out = (env_state, o, action, jnp.where(done, 0.0, reward), done)
+            return (new_state, done | step_done), out
+
+        (_, _), (states, obs, actions, rewards, dones) = jax.lax.scan(
+            scan_step, (env_state0, jnp.asarray(False)), length=self.max_len
+        )
+        return Trajectory(
+            states=states,
+            obs=obs,
+            actions=actions,
+            rewards=rewards,
+            dones=dones,
+            length=jnp.sum(~dones).astype(jnp.int32),
+        )

@@ -1,0 +1,709 @@
+"""MaF many-objective test suite (Cheng, Li, Tian, Zhang, Yang, Jin & Yao,
+"A benchmark test suite for evolutionary many-objective optimization",
+Complex & Intelligent Systems 3(1):67-81, 2017).
+
+Capability parity with reference src/evox/problems/numerical/maf.py:59-1166,
+re-designed around shared building blocks instead of 15 hand-expanded
+classes: one fliplr-cumprod "front product" helper covers every
+DTLZ/WFG-style shape, the WFG transformation functions (s_linear, b_flat,
+s_decept, s_multi, r_sum, r_nonsep) are standalone vectorized ops, and all
+group partitions are computed statically in Python (no fori_loop +
+dynamic_slice — objective count ``m`` is a static hyperparameter, so XLA
+sees straight-line fused code).
+
+Decision-space conventions (``bounds()``): [0, 1]^d for most members;
+MaF8/MaF9 are 2-D problems on [-10000, 10000]^2; MaF10-12 (the WFG
+members) use x_i in [0, 2i].
+
+Known reference quirks not replicated (behavior, not API): reference
+MaF10 indexes ``x[:, M]`` out of bounds (maf.py:600 — JAX clamps to the
+last column, so the correct ``x[:, M-1]`` is used here explicitly);
+reference MaF6.pf() divides every column by sqrt(2)^(m-2) (maf.py:350-362),
+which puts its front at norm < 1, off the achievable surface — the correct
+per-column exponents are used here (see MaF6.pf); MaF14/15 use the LSMOP
+decision-space box ([0,1]^(m-1) x [0,10]^rest) so the front is reachable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.problem import Problem
+from ...operators.sampling.uniform import UniformSampling
+from ...operators.selection.non_dominate import non_dominated_sort
+from .basic import griewank_func, rastrigin_func, rosenbrock_func, sphere_func
+
+
+# ----------------------------------------------------------------- helpers
+
+def front_product(a: jax.Array, b: jax.Array) -> jax.Array:
+    """The DTLZ/WFG objective-product pattern.
+
+    Given per-position terms ``a`` (n, m-1) and ``b`` (n, m-1), returns the
+    (n, m) matrix whose column j is ``prod(a[:, :m-1-j]) * (b[:, m-1-j] if
+    j > 0 else 1)`` — i.e. ``fliplr(cumprod([1, a])) * [1, reversed(b)]``.
+    """
+    n = a.shape[0]
+    ones = jnp.ones((n, 1), dtype=a.dtype)
+    cp = jnp.cumprod(jnp.concatenate([ones, a], axis=1), axis=1)[:, ::-1]
+    return cp * jnp.concatenate([ones, b[:, ::-1]], axis=1)
+
+
+def _linear(x: jax.Array) -> jax.Array:
+    return front_product(x, 1.0 - x)
+
+
+def _concave(x: jax.Array) -> jax.Array:
+    return front_product(jnp.sin(x * jnp.pi / 2), jnp.cos(x * jnp.pi / 2))
+
+
+def _sphere_front(x: jax.Array) -> jax.Array:
+    """cos-products with sin last (the DTLZ2 geometry)."""
+    return front_product(jnp.cos(x * jnp.pi / 2), jnp.sin(x * jnp.pi / 2))
+
+
+def _convex(x: jax.Array) -> jax.Array:
+    return front_product(1.0 - jnp.cos(x * jnp.pi / 2), 1.0 - jnp.sin(x * jnp.pi / 2))
+
+
+def _mixed(x: jax.Array, alpha: float = 1.0, A: float = 5.0) -> jax.Array:
+    """WFG 'mixed' last-objective shape (A=5 for WFG1/MaF10)."""
+    t = 2.0 * A * jnp.pi * x[:, 0] + jnp.pi / 2
+    return (1.0 - x[:, 0] - jnp.cos(t) / (2.0 * A * jnp.pi)) ** alpha
+
+
+def _disc(x: jax.Array) -> jax.Array:
+    """WFG 'disconnected' last-objective shape (WFG2/MaF11)."""
+    return 1.0 - x[:, 0] * jnp.cos(5.0 * jnp.pi * x[:, 0]) ** 2
+
+
+# WFG transformation functions (Huband et al. 2006), vectorized over (n, k)
+
+def s_linear(y: jax.Array, A: float) -> jax.Array:
+    return jnp.abs(y - A) / jnp.abs(jnp.floor(A - y) + A)
+
+
+def b_flat(y: jax.Array, A: float, B: float, C: float) -> jax.Array:
+    out = (
+        A
+        + jnp.minimum(0.0, jnp.floor(y - B)) * A * (B - y) / B
+        - jnp.minimum(0.0, jnp.floor(C - y)) * (1 - A) * (y - C) / (1 - C)
+    )
+    return jnp.round(out * 1e4) / 1e4  # the suite's standard f32 stabilization
+
+
+def s_decept(y: jax.Array, A: float, B: float, C: float) -> jax.Array:
+    return 1.0 + (jnp.abs(y - A) - B) * (
+        jnp.floor(y - A + B) * (1 - C + (A - B) / B) / (A - B)
+        + jnp.floor(A + B - y) * (1 - C + (1 - A - B) / B) / (1 - A - B)
+        + 1.0 / B
+    )
+
+
+def s_multi(y: jax.Array, A: float, B: float, C: float) -> jax.Array:
+    t = jnp.abs(y - C) / (2.0 * (jnp.floor(C - y) + C))
+    return (1.0 + jnp.cos((4 * A + 2) * jnp.pi * (0.5 - t)) + 4 * B * t**2) / (B + 2.0)
+
+
+def r_sum(y: jax.Array, w: jax.Array) -> jax.Array:
+    """Weighted-sum reduction over the last axis -> (n,)."""
+    return jnp.sum(y * w, axis=-1) / jnp.sum(w)
+
+
+def r_nonsep(y: jax.Array, A: int) -> jax.Array:
+    """Non-separable reduction (WFG r_nonsep) over the last axis -> (n,)."""
+    k = y.shape[-1]
+    out = jnp.zeros(y.shape[:-1])
+    for j in range(k):
+        out = out + y[..., j]
+        for l in range(A - 1):
+            out = out + jnp.abs(y[..., j] - y[..., (j + 1 + l) % k])
+    denom = (k / A) * math.ceil(A / 2) * (1.0 + 2.0 * A - 2.0 * math.ceil(A / 2))
+    return out / denom
+
+
+# polygon utilities (MaF8/MaF9; also exercised directly by tests)
+
+def ray_intersect_segment(point: jax.Array, seg_init: jax.Array, seg_term: jax.Array) -> jax.Array:
+    """Does a horizontal +x ray from ``point`` hit segment [seg_init, seg_term)?"""
+
+    def inside(x, a, b):
+        return (jnp.minimum(a, b) <= x) & (x < jnp.maximum(a, b))
+
+    y_dist = seg_term[1] - seg_init[1]
+    flat = (point[1] == seg_init[1]) & inside(point[0], seg_init[0], seg_term[0])
+    lhs = seg_init[0] * y_dist + (point[1] - seg_init[1]) * (seg_term[0] - seg_init[0])
+    rhs = point[0] * y_dist
+    crosses = ((y_dist > 0) & (lhs >= rhs)) | ((y_dist < 0) & (lhs <= rhs))
+    spans = inside(point[1], seg_init[1], seg_term[1])
+    return ((y_dist == 0) & flat) | ((y_dist != 0) & crosses & spans)
+
+
+def point_in_polygon(polygon: jax.Array, point: jax.Array) -> jax.Array:
+    """Ray-casting point-in-polygon test; vertices count as inside."""
+    seg_term = jnp.roll(polygon, 1, axis=0)
+    hits = jax.vmap(ray_intersect_segment, in_axes=(None, 0, 0))(
+        point, polygon, seg_term
+    )
+    on_vertex = jnp.any(jnp.all(polygon == point, axis=1))
+    return (jnp.sum(hits) % 2 == 1) | on_vertex
+
+
+def _polygon_vertices(m: int) -> jax.Array:
+    """Vertices of the regular m-gon inscribed in the unit circle, starting
+    at (0, 1) and advancing clockwise (the suite's convention)."""
+    theta = jnp.pi / 2 - jnp.arange(1, m + 1) * 2 * jnp.pi / m
+    return jnp.stack([jnp.cos(theta), jnp.sin(theta)], axis=1)
+
+
+# ----------------------------------------------------------------- base
+
+class MaFBase(Problem):
+    """Shared skeleton: m objectives, d decision variables, [0,1]^d box."""
+
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 1000):
+        self.m = m
+        self.d = d if d is not None else m + 9
+        self.ref_num = ref_num
+
+    def bounds(self) -> Tuple[jax.Array, jax.Array]:
+        return jnp.zeros((self.d,)), jnp.ones((self.d,))
+
+    def fit_shape(self, pop_size):
+        return (pop_size, self.m)
+
+    def _uniform_pts(self, m: int = None) -> jax.Array:
+        return UniformSampling(self.ref_num * self.m, m or self.m)()[0]
+
+
+# ----------------------------------------------------------------- members
+
+class MaF1(MaFBase):
+    """Inverted linear front (modified inverted DTLZ1)."""
+
+    def evaluate(self, state, X):
+        m = self.m
+        g = jnp.sum((X[:, m - 1:] - 0.5) ** 2, axis=1, keepdims=True)
+        return (1 + g) * (1.0 - _linear(X[:, : m - 1])), state
+
+    def pf(self):
+        return 1.0 - self._uniform_pts()
+
+
+class MaF2(MaFBase):
+    """Concave front with per-objective distance groups (DTLZ2BZ)."""
+
+    def _groups(self):
+        m, d = self.m, self.d
+        interval = (d - m + 1) // m
+        starts = [m - 1 + i * interval for i in range(m)]
+        ends = [m - 1 + (i + 1) * interval for i in range(m - 1)] + [d]
+        return starts, ends
+
+    def evaluate(self, state, X):
+        m = self.m
+        starts, ends = self._groups()
+        theta = X / 2.0 + 0.25
+        g = jnp.stack(
+            [
+                jnp.sum((theta[:, s:e] - 0.5) ** 2, axis=1)
+                for s, e in zip(starts, ends)
+            ],
+            axis=1,
+        )  # (n, m)
+        return (1 + g) * _sphere_front(theta[:, : m - 1]), state
+
+    def pf(self):
+        m = self.m
+        r = np.asarray(self._uniform_pts(), dtype=np.float64)
+        c = np.zeros((r.shape[0], m - 1))
+        for j in range(2, m + 1):
+            temp = r[:, j - 1] / r[:, 0] * np.prod(c[:, m - j + 1: m - 1], axis=1)
+            c[:, m - j] = np.sqrt(1.0 / (1.0 + temp**2))
+        lo, hi = np.cos(3 * np.pi / 8), np.cos(np.pi / 8)
+        if m > 5:
+            c = c * (hi - lo) + lo
+        else:
+            c = c[np.all((c >= lo) & (c <= hi), axis=1)]
+        ones = np.ones((c.shape[0], 1))
+        f = np.fliplr(np.cumprod(np.hstack([ones, c]), axis=1)) * np.hstack(
+            [ones, np.sqrt(1.0 - c[:, ::-1] ** 2)]
+        )
+        return jnp.asarray(f, dtype=jnp.float32)
+
+
+class MaF3(MaFBase):
+    """Convex DTLZ3: multimodal g, objectives raised to the 4th power."""
+
+    def evaluate(self, state, X):
+        m = self.m
+        tail = X[:, m - 1:] - 0.5
+        g = 100.0 * (
+            X.shape[1] - m + 1
+            + jnp.sum(tail**2 - jnp.cos(20 * jnp.pi * tail), axis=1, keepdims=True)
+        )
+        f1 = (1 + g) * _sphere_front(X[:, : m - 1])
+        return jnp.concatenate(
+            [f1[:, : m - 1] ** 4, f1[:, m - 1:] ** 2], axis=1
+        ), state
+
+    def pf(self):
+        r = self._uniform_pts() ** 2
+        temp = (jnp.sum(jnp.sqrt(r[:, :-1]), axis=1) + r[:, -1])[:, None]
+        return r / jnp.concatenate(
+            [jnp.tile(temp**2, (1, r.shape[1] - 1)), temp], axis=1
+        )
+
+
+class MaF4(MaFBase):
+    """Inverted, badly-scaled DTLZ3 (objective i scaled by 2^i)."""
+
+    def evaluate(self, state, X):
+        m = self.m
+        tail = X[:, m - 1:] - 0.5
+        g = 100.0 * (
+            X.shape[1] - m + 1
+            + jnp.sum(tail**2 - jnp.cos(20 * jnp.pi * tail), axis=1, keepdims=True)
+        )
+        f1 = (1 + g) * (1.0 - _sphere_front(X[:, : m - 1]))
+        return f1 * (2.0 ** jnp.arange(1, m + 1)), state
+
+    def pf(self):
+        r = self._uniform_pts()
+        r = r / jnp.linalg.norm(r, axis=1, keepdims=True)
+        return (1.0 - r) * (2.0 ** jnp.arange(1, self.m + 1))
+
+
+class MaF5(MaFBase):
+    """Convex, badly-scaled DTLZ4 (alpha=100 bias, objective i scaled 2^(m-i))."""
+
+    def evaluate(self, state, X):
+        m = self.m
+        xh = X[:, : m - 1] ** 100
+        g = jnp.sum((X[:, m - 1:] - 0.5) ** 2, axis=1, keepdims=True)
+        f1 = (1 + g) * _sphere_front(xh)
+        return f1 * (2.0 ** jnp.arange(m, 0, -1)), state
+
+    def pf(self):
+        r = self._uniform_pts()
+        r = r / jnp.linalg.norm(r, axis=1, keepdims=True)
+        return r * (2.0 ** jnp.arange(self.m, 0, -1))
+
+
+class MaF6(MaFBase):
+    """Degenerate front (DTLZ5(I, M) with I=2)."""
+
+    I = 2
+
+    def evaluate(self, state, X):
+        m = self.m
+        g = jnp.sum((X[:, m - 1:] - 0.5) ** 2, axis=1, keepdims=True)
+        head = X[:, : m - 1]
+        squeezed = (1.0 + 2.0 * g * head) / (2.0 + 2.0 * g)
+        theta = jnp.concatenate([head[:, : self.I - 1], squeezed[:, self.I - 1:]], axis=1)
+        return (1 + 100 * g) * _sphere_front(theta), state
+
+    def pf(self):
+        # true g=0 front: theta = (t, pi/4, ..., pi/4) through the sphere
+        # product gives per-column sqrt(2) exponents [m-2, m-2, m-3, ..., 1, 0]
+        # (the reference divides every column by sqrt(2)^(m-2), which puts its
+        # "front" at norm < 1 — off the achievable surface; fixed here)
+        r = self._uniform_pts(self.I)
+        r = r / jnp.linalg.norm(r, axis=1, keepdims=True)
+        pad = jnp.repeat(r[:, :1], self.m - self.I, axis=1)
+        pts = jnp.concatenate([pad, r], axis=1)  # (n, m): C x (m-1), then S
+        exps = np.concatenate(
+            [[self.m - 2], np.arange(self.m - 2, 0, -1), [0]]
+        ) if self.m > 2 else np.zeros(2)
+        return pts / jnp.sqrt(2.0) ** jnp.asarray(exps, dtype=pts.dtype)
+
+
+class MaF7(MaFBase):
+    """Disconnected front (DTLZ7)."""
+
+    def evaluate(self, state, X):
+        m = self.m
+        head = X[:, : m - 1]
+        g = 1.0 + 9.0 * jnp.mean(X[:, m - 1:], axis=1)
+        last = (1 + g) * (
+            m
+            - jnp.sum(
+                head / (1 + g[:, None]) * (1 + jnp.sin(3 * jnp.pi * head)), axis=1
+            )
+        )
+        return jnp.concatenate([head, last[:, None]], axis=1), state
+
+    def pf(self):
+        m = self.m
+        n = self.ref_num * m
+        interval = np.array([0.0, 0.251412, 0.631627, 0.859401])
+        median = (interval[1] - interval[0]) / (
+            interval[3] - interval[2] + interval[1] - interval[0]
+        )
+        gap = np.linspace(0, 1, int(math.ceil(n ** (1 / (m - 1)))))
+        X = np.stack(
+            [g.ravel() for g in np.meshgrid(*([gap] * (m - 1)))], axis=1
+        )
+        X = np.where(
+            X <= median, X * (interval[1] - interval[0]) / median + interval[0], X
+        )
+        X = np.where(
+            X > median,
+            (X - median) * (interval[3] - interval[2]) / (1 - median) + interval[2],
+            X,
+        )
+        last = 2.0 * (m - np.sum(X / 2.0 * (1 + np.sin(3 * np.pi * X)), axis=1))
+        return jnp.asarray(
+            np.hstack([X, last[:, None]]), dtype=jnp.float32
+        )
+
+
+class _PolygonProblem(MaFBase):
+    """Common machinery for the 2-D polygon members MaF8/MaF9."""
+
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 1000):
+        super().__init__(2, m, ref_num)
+        self.points = _polygon_vertices(self.m)
+
+    def bounds(self):
+        return jnp.full((2,), -10000.0), jnp.full((2,), 10000.0)
+
+    def _pf_grid(self, order: str):
+        n = self.ref_num * self.m
+        temp = np.linspace(-1, 1, int(math.ceil(math.sqrt(n))))
+        y, x = np.meshgrid(temp, temp)
+        pts = np.column_stack([x.ravel(order=order), y.ravel(order=order)])
+        nd = np.asarray(
+            jax.vmap(point_in_polygon, in_axes=(None, 0))(
+                self.points, jnp.asarray(pts, dtype=jnp.float32)
+            )
+        )
+        return jnp.asarray(pts[nd], dtype=jnp.float32)
+
+
+class MaF8(_PolygonProblem):
+    """Distance to the vertices of a regular m-gon (d=2)."""
+
+    def evaluate(self, state, X):
+        X = X[:, :2]
+        return jnp.linalg.norm(X[:, None, :] - self.points[None], axis=-1), state
+
+    def pf(self):
+        pts = self._pf_grid(order="F")
+        return jnp.linalg.norm(pts[:, None, :] - self.points[None], axis=-1)
+
+
+class MaF9(_PolygonProblem):
+    """Distance to the edges (lines) of a regular m-gon (d=2)."""
+
+    def _line_distances(self, X):
+        m = self.m
+
+        def dist_to_edge(i):
+            a = self.points[i % m]
+            b = self.points[(i + 1) % m]
+            num = jnp.abs(
+                (a[0] - X[:, 0]) * (b[1] - X[:, 1]) - (b[0] - X[:, 0]) * (a[1] - X[:, 1])
+            )
+            return num / jnp.linalg.norm(a - b)
+
+        return jax.vmap(dist_to_edge)(jnp.arange(m)).T
+
+    def evaluate(self, state, X):
+        return self._line_distances(X[:, :2]), state
+
+    def pf(self):
+        return self._line_distances(self._pf_grid(order="C"))
+
+
+class _WFGBase(MaFBase):
+    """Shared WFG scaffolding: z in [0, 2i], K=m-1 position vars."""
+
+    def bounds(self):
+        return jnp.zeros((self.d,)), 2.0 * jnp.arange(1, self.d + 1, dtype=jnp.float32)
+
+    @property
+    def K(self):
+        return self.m - 1
+
+    def _z01(self, X):
+        return X / (2.0 * jnp.arange(1, self.d + 1, dtype=X.dtype))
+
+    def _scale(self):
+        return 2.0 * jnp.arange(1, self.m + 1, dtype=jnp.float32)
+
+    def _wfg_x(self, t_head, t_last):
+        # A_i = 1 for all members here, so max(t_last, 1) == 1
+        return jnp.concatenate([t_head, t_last[:, None]], axis=1)
+
+    def _pf_position(self, shape_fn, last_shape_fn):
+        """WFG fronts: optimal distance params -> front traced by position
+        params; sampled via the suite's direction-fitting construction."""
+        m = self.m
+        R = np.asarray(self._uniform_pts(), dtype=np.float64)
+        c = np.ones((R.shape[0], m))
+        for j in range(1, m):
+            temp = R[:, j] / R[:, 0] * np.prod(1 - c[:, m - j: m - 1], axis=1)
+            c[:, m - j - 1] = (temp**2 - temp + np.sqrt(2 * temp)) / (temp**2 + 1)
+        x = np.arccos(np.clip(c, -1.0, 1.0)) * 2 / np.pi
+        a = np.linspace(0, 1, 10001)[None, :]
+        E = np.abs(
+            ((1 - np.sin(np.pi / 2 * x[:, 1])) * R[:, m - 1] / R[:, m - 2])[:, None]
+            * last_shape_fn(a)
+            - shape_fn(a)
+        )
+        x[:, 0] = a[0, np.argmin(E, axis=1)]
+        return jnp.asarray(x, dtype=jnp.float32)
+
+
+class MaF10(_WFGBase):
+    """WFG1: flat-bias + polynomial-bias transformations, convex+mixed front."""
+
+    def evaluate(self, state, X):
+        m, K = self.m, self.K
+        L = self.d - K
+        z01 = self._z01(X)
+        t1 = jnp.concatenate([z01[:, :K], s_linear(z01[:, K:], 0.35)], axis=1)
+        t2 = jnp.concatenate([t1[:, :K], b_flat(t1[:, K:], 0.8, 0.75, 0.85)], axis=1)
+        t3 = t2**0.02
+        kg = K // (m - 1)
+        col_w = 2.0 * jnp.arange(1, self.d + 1)
+        t4_head = jnp.stack(
+            [
+                r_sum(t3[:, i * kg:(i + 1) * kg], col_w[i * kg:(i + 1) * kg])
+                for i in range(m - 1)
+            ],
+            axis=1,
+        )
+        t4_last = r_sum(t3[:, K:], col_w[K: K + L])
+        x = self._wfg_x(
+            jnp.maximum(t4_last[:, None], 1.0) * (t4_head - 0.5) + 0.5, t4_last
+        )
+        h = _convex(x[:, : m - 1]).at[:, m - 1].set(_mixed(x))
+        f = x[:, m - 1:] + self._scale() * h
+        return f, state
+
+    def pf(self):
+        m = self.m
+        x = self._pf_position(
+            lambda a: 1 - a - np.cos(10 * np.pi * a + np.pi / 2) / (10 * np.pi),
+            lambda a: 1 - np.cos(np.pi / 2 * a),
+        )
+        f = np.array(_convex(jnp.asarray(x[:, : m - 1])))
+        f[:, m - 1] = np.asarray(_mixed(jnp.asarray(x)))
+        return jnp.asarray(f) * self._scale()
+
+
+class MaF11(_WFGBase):
+    """WFG2: non-separable pairwise reduction, convex + disconnected front."""
+
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 1000):
+        super().__init__(d, m, ref_num)
+        # L must be even for the pairwise reduction
+        self.d = int(math.ceil((self.d - self.m + 1) / 2) * 2 + self.m - 1)
+
+    def evaluate(self, state, X):
+        m, K = self.m, self.K
+        L = self.d - K
+        z01 = self._z01(X)
+        t1 = jnp.concatenate([z01[:, :K], s_linear(z01[:, K:], 0.35)], axis=1)
+        a, b = t1[:, K::2], t1[:, K + 1:: 2]
+        pair = (a + b + 2.0 * jnp.abs(a - b)) / 3.0
+        t2 = jnp.concatenate([t1[:, :K], pair], axis=1)
+        kg = K // (m - 1)
+        t3_head = jnp.stack(
+            [
+                r_sum(t2[:, i * kg:(i + 1) * kg], jnp.ones((kg,)))
+                for i in range(m - 1)
+            ],
+            axis=1,
+        )
+        t3_last = r_sum(t2[:, K: K + L // 2], jnp.ones((L // 2,)))
+        x = self._wfg_x(
+            jnp.maximum(t3_last[:, None], 1.0) * (t3_head - 0.5) + 0.5, t3_last
+        )
+        h = _convex(x[:, : m - 1]).at[:, m - 1].set(_disc(x))
+        f = x[:, m - 1:] + self._scale() * h
+        return f, state
+
+    def pf(self):
+        m = self.m
+        x = self._pf_position(
+            lambda a: 1 - a * np.cos(5 * np.pi * a) ** 2,
+            lambda a: 1 - np.cos(np.pi / 2 * a),
+        )
+        R = np.array(_convex(jnp.asarray(x[:, : m - 1])))
+        R[:, m - 1] = np.asarray(_disc(jnp.asarray(x)))
+        nd = np.asarray(non_dominated_sort(jnp.asarray(R))) == 0
+        return jnp.asarray(R[nd]) * self._scale()
+
+
+class MaF12(_WFGBase):
+    """WFG9: deceptive + multimodal transformations, concave front."""
+
+    def evaluate(self, state, X):
+        m, K = self.m, self.K
+        L = self.d - K
+        z01 = self._z01(X)
+        n = X.shape[0]
+        # b_param: bias each variable by the mean of those after it
+        csum = jnp.cumsum(z01[:, ::-1], axis=1)[:, ::-1]
+        Y = (csum - z01) / jnp.arange(K + L - 1, -1, -1)
+        head = z01[:, :-1] ** (
+            0.02
+            + (50 - 0.02)
+            * (
+                0.98 / 49.98
+                - (1 - 2 * Y[:, :-1])
+                * jnp.abs(jnp.floor(0.5 - Y[:, :-1]) + 0.98 / 49.98)
+            )
+        )
+        t1 = jnp.concatenate([head, z01[:, -1:]], axis=1)
+        t2 = jnp.concatenate(
+            [s_decept(t1[:, :K], 0.35, 0.001, 0.05), s_multi(t1[:, K:], 30, 95, 0.35)],
+            axis=1,
+        )
+        kg = K // (m - 1)
+        t3_head = jnp.stack(
+            [r_nonsep(t2[:, i * kg:(i + 1) * kg], kg) for i in range(m - 1)], axis=1
+        )
+        t3_last = r_nonsep(t2[:, K:], L)
+        x = self._wfg_x(
+            jnp.maximum(t3_last[:, None], 1.0) * (t3_head - 0.5) + 0.5, t3_last
+        )
+        h = front_product(jnp.sin(x[:, : m - 1] * jnp.pi / 2), jnp.cos(x[:, : m - 1] * jnp.pi / 2))
+        f = x[:, m - 1:] + self._scale() * h
+        return f, state
+
+    def pf(self):
+        r = self._uniform_pts()
+        r = r / jnp.linalg.norm(r, axis=1, keepdims=True)
+        return r * self._scale()
+
+
+class MaF13(MaFBase):
+    """Degenerate 3-D core front embedded in m objectives, with a
+    non-separable variable linkage."""
+
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 1000):
+        # the front's 3-D core needs at least 3 objectives; default d matches
+        # the reference's effective value (its d=5 is overwritten to m+9)
+        super().__init__(d, max(m, 3), ref_num)
+
+    def evaluate(self, state, X):
+        n, D = X.shape
+        m = self.m
+        Y = X - 2.0 * X[:, 1:2] * jnp.sin(
+            2 * jnp.pi * X[:, 0:1] + jnp.arange(1, D + 1) * jnp.pi / D
+        )
+
+        def mean_sq(sl):
+            return 2.0 * jnp.mean(Y[:, sl] ** 2, axis=1)
+
+        f0 = jnp.sin(X[:, 0] * jnp.pi / 2) + mean_sq(slice(3, D, 3))
+        f1 = (
+            jnp.cos(X[:, 0] * jnp.pi / 2) * jnp.sin(X[:, 1] * jnp.pi / 2)
+            + mean_sq(slice(4, D, 3))
+        )
+        f2 = (
+            jnp.cos(X[:, 0] * jnp.pi / 2) * jnp.cos(X[:, 1] * jnp.pi / 2)
+            + mean_sq(slice(2, D, 3))
+        )
+        rest = (f0**2 + f1**10 + f2**10 + mean_sq(slice(3, D)))[:, None]
+        return jnp.concatenate(
+            [jnp.stack([f0, f1, f2], axis=1), jnp.tile(rest, (1, m - 3))], axis=1
+        ), state
+
+    def pf(self):
+        r = UniformSampling(self.ref_num * self.m, 3)()[0]
+        r = r / jnp.linalg.norm(r, axis=1, keepdims=True)
+        rest = (r[:, 0] ** 2 + r[:, 1] ** 10 + r[:, 2] ** 10)[:, None]
+        return jnp.concatenate([r, jnp.tile(rest, (1, self.m - 3))], axis=1)
+
+
+class _LargeScaleBase(MaFBase):
+    """MaF14/15 scaffolding: chaos-weighted variable groups, two inner
+    functions alternating across objectives (the LSMOP construction)."""
+
+    nk = 2
+
+    def __init__(self, d: int = None, m: int = 3, ref_num: int = 1000):
+        super().__init__(d if d is not None else 20 * m, m, ref_num)
+        c = [3.8 * 0.1 * (1 - 0.1)]
+        for _ in range(1, self.m):
+            c.append(3.8 * c[-1] * (1 - c[-1]))
+        c = np.array(c)
+        self.sublen = tuple(
+            int(v) for v in np.floor(c / c.sum() * (self.d - self.m + 1) / self.nk)
+        )
+        self.glen = tuple(int(v) for v in np.concatenate(
+            [[0], np.cumsum(np.array(self.sublen) * self.nk)]
+        ))
+
+    def bounds(self) -> Tuple[jax.Array, jax.Array]:
+        # distance variables range up to 10 (LSMOP convention, same as
+        # lsmop.py) — with [0,1]^d the linkage could never cancel and the
+        # front would be unreachable
+        lb = jnp.zeros((self.d,))
+        ub = jnp.concatenate(
+            [jnp.ones((self.m - 1,)), 10.0 * jnp.ones((self.d - self.m + 1,))]
+        )
+        return lb, ub
+
+    def _group_g(self, X, even_fn, odd_fn):
+        m = self.m
+        G = []
+        for i in range(m):
+            fn = even_fn if i % 2 == 0 else odd_fn
+            acc = 0.0
+            for j in range(self.nk):
+                start = self.glen[i] + m - 1 + j * self.sublen[i]
+                acc = acc + fn(X[:, start: start + self.sublen[i]])
+            G.append(acc / (self.sublen[i] * self.nk))
+        return jnp.stack(G, axis=1)  # (n, m)
+
+
+class MaF14(_LargeScaleBase):
+    """Large-scale linear front, partially separable (Rastrigin/Rosenbrock)."""
+
+    def evaluate(self, state, X):
+        m, D = self.m, X.shape[1]
+        X = X.at[:, m - 1:].set(
+            (1.0 + jnp.arange(m, D + 1) / D) * X[:, m - 1:] - X[:, 0:1] * 10.0
+        )
+        G = self._group_g(X, rastrigin_func, rosenbrock_func)
+        return (1 + G) * _linear(X[:, : m - 1]), state
+
+    def pf(self):
+        return self._uniform_pts()
+
+
+class MaF15(_LargeScaleBase):
+    """Large-scale inverted concave front (Griewank/Sphere)."""
+
+    def evaluate(self, state, X):
+        m, D = self.m, X.shape[1]
+        X = X.at[:, m - 1:].set(
+            (1.0 + jnp.cos(jnp.arange(m, D + 1) / D * jnp.pi / 2.0)) * X[:, m - 1:]
+            - X[:, 0:1] * 10.0
+        )
+        G = self._group_g(X, griewank_func, sphere_func)
+        G_shift = jnp.concatenate([G[:, 1:], jnp.zeros((X.shape[0], 1))], axis=1)
+        return (1 + G + G_shift) * (1.0 - _sphere_front(X[:, : m - 1])), state
+
+    def pf(self):
+        r = self._uniform_pts()
+        return 1.0 - r / jnp.linalg.norm(r, axis=1, keepdims=True)
+
+
+__all__ = [
+    "MaF1", "MaF2", "MaF3", "MaF4", "MaF5", "MaF6", "MaF7", "MaF8", "MaF9",
+    "MaF10", "MaF11", "MaF12", "MaF13", "MaF14", "MaF15",
+    "front_product", "point_in_polygon", "ray_intersect_segment",
+    "s_linear", "b_flat", "s_decept", "s_multi", "r_sum", "r_nonsep",
+]

@@ -226,3 +226,34 @@ def test_env_step_shapes(name):
     s2, r, d = env.step(s, a)
     assert jax.tree.structure(s2) == jax.tree.structure(s)
     assert jnp.shape(r) == () and jnp.shape(d) == ()
+
+
+def test_visualize_trajectory():
+    """visualize() traces one rollout; its return matches evaluate()'s
+    fitness for the same (deterministic) episode seed."""
+    env, apply, adapter = _cartpole_setup()
+    problem = PolicyRolloutProblem(
+        apply, env, num_episodes=1, stochastic_reset=False
+    )
+    params = adapter.to_tree(jnp.zeros(adapter.dim))
+    pstate = problem.init(jax.random.PRNGKey(3))
+    _, k_eps = jax.random.fold_in(pstate.key, 0), jax.random.fold_in(pstate.key, 0)
+    ep_key = jax.random.split(k_eps, 1)[0]
+    traj = problem.visualize(params, key=ep_key)
+    assert traj.obs.shape == (env.max_steps, env.obs_dim)
+    assert traj.actions.shape == (env.max_steps, env.act_dim)
+    assert traj.rewards.shape == (env.max_steps,)
+    assert bool(jnp.all(traj.rewards[traj.dones] == 0.0))
+    # the traced return equals evaluate()'s fitness on the same seed
+    batched = jax.tree.map(lambda x: x[None], params)
+    fit, _ = problem.evaluate(pstate, batched)
+    np.testing.assert_allclose(
+        float(jnp.sum(traj.rewards)), float(fit[0]), rtol=1e-5
+    )
+    # once done, the state freezes
+    t_done = int(jnp.argmax(traj.dones)) if bool(jnp.any(traj.dones)) else None
+    if t_done is not None and t_done + 2 < env.max_steps:
+        frozen = jax.tree.map(lambda x: x[t_done + 1], traj.states)
+        frozen2 = jax.tree.map(lambda x: x[t_done + 2], traj.states)
+        for a, b in zip(jax.tree.leaves(frozen), jax.tree.leaves(frozen2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

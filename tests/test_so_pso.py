@@ -12,6 +12,7 @@ from evox_tpu.algorithms.so.pso import (
     FSPSO,
     SLPSOGS,
     SLPSOUS,
+    SwmmPSO,
     topology,
 )
 from evox_tpu.monitors import EvalMonitor
@@ -47,6 +48,15 @@ def test_fips():
 
 def test_dms_pso_el():
     algo = DMSPSOEL(LB, UB, pop_size=60, sub_swarm_size=10, max_iteration=200)
+    assert run_algorithm(algo, 200) < 0.5
+
+
+def test_swmmpso():
+    assert run_algorithm(SwmmPSO(LB, UB, pop_size=64), 200) < 0.1
+
+
+def test_swmmpso_shortcuts():
+    algo = SwmmPSO(LB, UB, pop_size=64, shortcut_p=0.05)
     assert run_algorithm(algo, 200) < 0.5
 
 
