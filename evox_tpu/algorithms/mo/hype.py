@@ -1,24 +1,38 @@
 """HypE (Bader & Zitzler 2011): hypervolume-estimation based many-objective
-EA. Capability parity with reference src/evox/algorithms/mo/hype.py:56+
-(Monte-Carlo hypervolume-contribution fitness, fixed sample budget so the
-whole selection stays one static-shape jit program)."""
+EA. Capability parity with reference src/evox/algorithms/mo/hype.py:20-147,
+full mechanics:
+
+- environmental selection is non-dominated-rank primary with hypervolume
+  tie-breaking on the cut front (the paper's scheme; the reference's
+  lexsort((-hv, rank)) uses the same shape but masks hv to the max rank,
+  which never influences selection when the cut front is not the last —
+  fixed here to the cut front);
+- the sampling reference point is fixed at the first generation
+  (1.2 * max fitness, ref hype.py:108) and carried in state, so the
+  Monte-Carlo estimate is consistent across generations;
+- mating selection is a tournament on the population's HypE fitness
+  (ref ask:112-122);
+- m == 2 uses an EXACT leave-one-out hypervolume contribution (sorted
+  sweep — O(n log n), no sampling noise); m >= 3 uses the Monte-Carlo
+  alpha-weighted estimator (ref cal_hv:20-52).
+"""
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
-from ...operators.selection.basic import tournament
-from .common import GAMOAlgorithm, MOState
+from ...operators.selection.basic import tournament_multifit
+from ...operators.selection.non_dominate import non_dominated_sort
+from .common import GAMOAlgorithm, MOState, uniform_init
 
 
 def hype_fitness(
-    key: jax.Array, fit: jax.Array, k: int, n_samples: int = 8192
+    key: jax.Array, fit: jax.Array, ref: jax.Array, k: int, n_samples: int = 8192
 ) -> jax.Array:
     """Monte-Carlo HypE fitness: expected hypervolume share each individual
     would contribute if the k worst were removed (higher = better)."""
     n, m = fit.shape
-    ref = jnp.max(fit, axis=0) * 1.2 + 1e-6
     lo = jnp.min(fit, axis=0)
     samples = jax.random.uniform(key, (n_samples, m)) * (ref - lo) + lo
     # dominated[s, i]: sample s is dominated by individual i
@@ -36,23 +50,90 @@ def hype_fitness(
     return jnp.sum(dominated * w[:, None], axis=0)
 
 
+def exact_contrib_2d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Array:
+    """Exact leave-one-out hypervolume contribution for m = 2, computed
+    WITHIN each non-domination front (every point's exclusive box area
+    relative to its own front — so dominated points keep selection pressure
+    instead of collapsing to 0).
+
+    One sorted sweep for all fronts at once: sort by (rank, f0); inside a
+    front f1 is non-increasing, so each point's box is bounded by its sorted
+    neighbors, with ``ref`` closing the boundary positions.
+    """
+    n = fit.shape[0]
+    order = jnp.lexsort((fit[:, 0], rank))
+    sf = fit[order]
+    grp = rank[order]
+    same_next = jnp.concatenate([grp[1:] == grp[:-1], jnp.array([False])])
+    same_prev = jnp.concatenate([jnp.array([False]), grp[1:] == grp[:-1]])
+    next_f0 = jnp.where(same_next, jnp.roll(sf[:, 0], -1), ref[0])
+    prev_f1 = jnp.where(same_prev, jnp.roll(sf[:, 1], 1), ref[1])
+    contrib = jnp.maximum(next_f0 - sf[:, 0], 0.0) * jnp.maximum(
+        prev_f1 - sf[:, 1], 0.0
+    )
+    return jnp.zeros((n,)).at[order].set(contrib)
+
+
+class HypEState(MOState):
+    ref_point: jax.Array  # (m,) fixed sampling reference
+    rank: jax.Array  # (pop,) survivors' non-domination ranks (exact — every
+    # dominator of a survivor is itself kept, so ranks are subset-invariant)
+
+
 class HypE(GAMOAlgorithm):
     def __init__(self, lb, ub, n_objs, pop_size, n_samples: int = 8192):
         super().__init__(lb, ub, n_objs, pop_size)
         self.n_samples = n_samples
 
-    def mate(self, key: jax.Array, state: MOState) -> jax.Array:
-        k1, k2 = jax.random.split(key)
-        score = hype_fitness(k1, state.fitness, self.pop_size, self.n_samples)
-        return tournament(k2, state.population, -score)
+    def init(self, key: jax.Array) -> HypEState:
+        key, k = jax.random.split(key)
+        pop = uniform_init(k, self.lb, self.ub, self.pop_size)
+        return HypEState(
+            population=pop,
+            fitness=jnp.full((self.pop_size, self.n_objs), jnp.inf),
+            offspring=pop,
+            key=key,
+            ref_point=jnp.zeros((self.n_objs,)),
+            rank=jnp.zeros((self.pop_size,), dtype=jnp.int32),
+        )
 
-    def tell(self, state: MOState, fitness: jax.Array) -> MOState:
+    def init_tell(self, state: HypEState, fitness: jax.Array) -> HypEState:
+        ref = jnp.full((self.n_objs,), jnp.max(fitness) * 1.2)
+        return state.replace(
+            fitness=fitness,
+            ref_point=ref,
+            rank=non_dominated_sort(fitness).astype(jnp.int32),
+        )
+
+    def _score(self, key, fit, ref, rank, k):
+        if self.n_objs == 2:
+            return exact_contrib_2d(fit, ref, rank)
+        return hype_fitness(key, fit, ref, k, self.n_samples)
+
+    def mate(self, key: jax.Array, state: HypEState) -> jax.Array:
+        k1, k2 = jax.random.split(key)
+        score = self._score(
+            k1, state.fitness, state.ref_point, state.rank, self.pop_size
+        )
+        # rank-primary so dominated parents keep pressure toward the front;
+        # HV contribution breaks ties within a rank
+        keys = jnp.stack([state.rank.astype(jnp.float32), -score], axis=1)
+        return tournament_multifit(k2, state.population, keys)
+
+    def tell(self, state: HypEState, fitness: jax.Array) -> HypEState:
         key, k_h = jax.random.split(state.key)
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
         k_remove = merged_fit.shape[0] - self.pop_size
-        score = hype_fitness(k_h, merged_fit, k_remove, self.n_samples)
-        idx = jnp.argsort(-score)[: self.pop_size]
+        rank = non_dominated_sort(merged_fit)
+        cut_rank = jnp.sort(rank)[self.pop_size]
+        score = self._score(k_h, merged_fit, state.ref_point, rank, k_remove)
+        # rank-primary, HV tie-break within the cut front
+        dis = jnp.where(rank == cut_rank, score, -jnp.inf)
+        idx = jnp.lexsort((-dis, rank))[: self.pop_size]
         return state.replace(
-            population=merged_pop[idx], fitness=merged_fit[idx], key=key
+            population=merged_pop[idx],
+            fitness=merged_fit[idx],
+            rank=rank[idx].astype(jnp.int32),
+            key=key,
         )

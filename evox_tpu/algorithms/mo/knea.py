@@ -1,44 +1,196 @@
 """KnEA (Zhang, Tian & Jin 2015): knee-point driven many-objective EA.
-Capability parity with reference src/evox/algorithms/mo/knea.py:39+:
-knee points = maximal distance to the extreme hyperplane within adaptive
-neighborhoods; selection prefers (rank, knee, distance)."""
+
+Full mechanics, capability parity with reference
+src/evox/algorithms/mo/knea.py:26-221:
+
+- per-front extreme hyperplane (solve through the objective-wise maxima,
+  diagonal fallback when singular) and knee identification by greedy
+  neighborhood suppression in plane-distance order;
+- adaptive suppression radius R = (max - min) * r with
+  r <- r * exp(-(1 - t/rate)/M) carried across fronts and generations
+  (t = knee fraction of the previous front);
+- environmental selection keeps all safer fronts plus the cut front's
+  knees, topping up / trimming by plane distance;
+- mating selection is a binary tournament on (rank, knee-ness, weighted
+  neighbor distance DW) — the paper's three-level comparison. (The
+  reference constructs the same three keys but its Tournament consumes
+  only the first; the full lexicographic comparison is used here.)
+"""
 
 from __future__ import annotations
+
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ...operators.selection.basic import tournament_multifit
 from ...operators.selection.non_dominate import non_dominated_sort
 from ...utils.common import pairwise_euclidean_dist
-from .common import GAMOAlgorithm, MOState
+from .common import GAMOAlgorithm, MOState, uniform_init
 
 
-def _hyperplane_distance(fit: jax.Array) -> jax.Array:
-    """Signed distance of each point to the hyperplane through the extreme
-    values of the current set (larger = more knee-like, for minimization)."""
-    fmax = jnp.max(fit, axis=0)
-    fmin = jnp.min(fit, axis=0)
-    w = 1.0 / jnp.maximum(fmax - fmin, 1e-12)
-    b = jnp.sum(w * fmax)
-    return (b - fit @ w) / jnp.linalg.norm(w)
+class KnEAState(MOState):
+    knee: jax.Array  # (pop,) bool
+    rank: jax.Array  # (pop,) survivors' non-domination ranks (exact: every
+    # dominator of a survivor is itself kept, so ranks are subset-invariant)
+    r: jax.Array  # () adaptive radius factor
+    t: jax.Array  # () knee ratio of the last processed front
+
+
+def weighted_neighbor_dist(fit: jax.Array, k: int) -> jax.Array:
+    """DW: distance to the k nearest neighbors, weighted toward the ones
+    closest to the neighborhood's mean distance (reference knea.py:27-35)."""
+    dis = pairwise_euclidean_dist(fit, fit)
+    order = jnp.argsort(dis, axis=1)
+    neighbor = jnp.take_along_axis(dis, order[:, 1 : k + 1], axis=1)
+    avg = jnp.mean(neighbor, axis=1, keepdims=True)
+    w = 1.0 / jnp.maximum(jnp.abs(neighbor - avg), 1e-12)
+    w = w / jnp.sum(w, axis=1, keepdims=True)
+    return jnp.sum(neighbor * w, axis=1)
+
+
+def _front_plane(f_front: jax.Array, m: int) -> jax.Array:
+    """Normal of the hyperplane through the front's per-objective maxima
+    (rows of ``f_front`` outside the front are NaN)."""
+    extreme = f_front[jnp.nanargmax(f_front, axis=0)]  # (m, m)
+
+    def solve_plane(pts):
+        return jnp.linalg.solve(pts, jnp.ones(m))
+
+    def diag_plane(pts):
+        return jnp.linalg.solve(
+            jnp.diag(jnp.clip(jnp.diagonal(pts), 1e-6)), jnp.ones(m)
+        )
+
+    ok = jnp.linalg.matrix_rank(extreme) == m
+    return jax.lax.cond(ok, solve_plane, diag_plane, extreme)
 
 
 class KnEA(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, knee_rate: float = 0.5):
+    def __init__(
+        self,
+        lb,
+        ub,
+        n_objs: int,
+        pop_size: int,
+        knee_rate: float = 0.5,
+        k_neighbors: int = 3,
+    ):
         super().__init__(lb, ub, n_objs, pop_size)
         self.knee_rate = knee_rate
+        self.k_neighbors = k_neighbors
 
-    def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
-        rank = non_dominated_sort(fit)
-        dist = _hyperplane_distance(fit)
-        # neighborhood knee detection: a point is a knee if it has the max
-        # hyperplane distance within its K-nearest neighborhood
-        n = fit.shape[0]
-        K = max(1, int(n * self.knee_rate * 0.1))
-        pd = pairwise_euclidean_dist(fit, fit)
-        _, nbr = jax.lax.top_k(-pd, K + 1)  # includes self
-        knee = dist >= jnp.max(dist[nbr], axis=1)
-        # order: rank asc, knees first within rank, then distance desc
-        order = jnp.lexsort((-dist, ~knee, rank))
-        idx = order[: self.pop_size]
-        return pop[idx], fit[idx]
+    def init(self, key: jax.Array) -> KnEAState:
+        key, k = jax.random.split(key)
+        pop = uniform_init(k, self.lb, self.ub, self.pop_size)
+        return KnEAState(
+            population=pop,
+            fitness=jnp.full((self.pop_size, self.n_objs), jnp.inf),
+            offspring=pop,
+            key=key,
+            knee=jnp.zeros((self.pop_size,), dtype=bool),
+            rank=jnp.zeros((self.pop_size,), dtype=jnp.int32),
+            r=jnp.ones(()),
+            t=jnp.zeros(()),
+        )
+
+    def init_tell(self, state: KnEAState, fitness: jax.Array) -> KnEAState:
+        return state.replace(
+            fitness=fitness, rank=non_dominated_sort(fitness).astype(jnp.int32)
+        )
+
+    def mate(self, key: jax.Array, state: KnEAState) -> jax.Array:
+        dw = weighted_neighbor_dist(state.fitness, self.k_neighbors)
+        keys = jnp.stack(
+            [
+                state.rank.astype(jnp.float32),  # cached by tell
+                (~state.knee).astype(jnp.float32),
+                -dw,
+            ],
+            axis=1,
+        )
+        return tournament_multifit(key, state.population, keys)
+
+    def tell(self, state: KnEAState, fitness: jax.Array) -> KnEAState:
+        m = self.n_objs
+        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        n = merged_fit.shape[0]
+
+        rank = non_dominated_sort(merged_fit)
+        order = jnp.argsort(rank)
+        rank = rank[order]
+        pop = merged_pop[order]
+        fit = merged_fit[order]
+        last_rank = rank[self.pop_size]
+        fit_sel = jnp.where((rank <= last_rank)[:, None], fit, jnp.nan)
+
+        # --- knee identification, front by front (sequential: the adaptive
+        # radius r depends on the previous front's knee ratio t) ----------
+        def per_front(i, carry):
+            knee, r, t, plane = carry
+            in_front = rank == i
+            f_i = jnp.where(in_front[:, None], fit_sel, jnp.nan)
+            mx = jnp.nanmax(f_i, axis=0)
+            mn = jnp.nanmin(f_i, axis=0)
+            plane = _front_plane(f_i, m)
+            dist = plane @ f_i.T  # smaller = farther past the plane
+            order_i = jnp.argsort(dist)  # NaNs sort last
+            r = r * jnp.exp(-(1.0 - t / self.knee_rate) / m)
+            R = (mx - mn) * r
+
+            def greedy(j, kn):
+                p = order_i[j]
+
+                def suppress(kn):
+                    near = jnp.all(jnp.abs(f_i - f_i[p]) < R, axis=1)
+                    return kn & ~near.at[p].set(False)
+
+                return jax.lax.cond(kn[p], suppress, lambda kn: kn, kn)
+
+            front_size = jnp.sum(in_front)
+            knee = jax.lax.fori_loop(0, front_size, greedy, knee)
+            t = jnp.sum(in_front & knee) / jnp.maximum(front_size, 1)
+            return knee, r, t, plane
+
+        knee0 = jnp.ones((n,), dtype=bool)
+        plane0 = jnp.full((m,), jnp.nan)
+        knee, r, t, plane = jax.lax.fori_loop(
+            0, last_rank + 1, per_front, (knee0, state.r, state.t, plane0)
+        )
+        knee = knee & (rank <= last_rank)
+
+        # --- environmental selection ------------------------------------
+        selected = (rank < last_rank) | knee
+        dif = jnp.sum(selected) - self.pop_size
+        in_cut = rank == last_rank
+        plane_dist = plane @ jnp.where(jnp.isnan(fit_sel), 0.0, fit_sel).T
+
+        def trim(sel):
+            # too many: drop cut-front knees closest to the plane (least
+            # knee-like) first — descending plane-dot order (ref knea.py:184-193)
+            cand = knee & in_cut
+            drop_order = jnp.argsort(jnp.where(cand, -plane_dist, jnp.inf))
+            idx = jnp.where(jnp.arange(n) < dif, drop_order, n)
+            return sel.at[idx].set(False, mode="drop")
+
+        def top_up(sel):
+            # too few: add cut-front non-knees farthest past the plane
+            cand = (~knee) & in_cut
+            score = jnp.where(cand, plane_dist, jnp.inf)
+            add_order = jnp.argsort(score)  # smallest plane distance first
+            idx = jnp.where(jnp.arange(n) < -dif, add_order, n)
+            return sel.at[idx].set(True, mode="drop")
+
+        selected = jax.lax.cond(dif > 0, trim, lambda s: s, selected)
+        selected = jax.lax.cond(dif < 0, top_up, lambda s: s, selected)
+        idx = jnp.sort(jnp.where(selected, jnp.arange(n), n))[: self.pop_size]
+        return state.replace(
+            population=pop[idx],
+            fitness=fit[idx],
+            knee=knee[idx],
+            rank=rank[idx].astype(jnp.int32),
+            r=r,
+            t=t,
+        )

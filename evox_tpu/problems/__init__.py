@@ -1,4 +1,6 @@
 from . import numerical
 from . import neuroevolution
+from . import supervised
+from . import evoxbench
 
-__all__ = ["numerical", "neuroevolution"]
+__all__ = ["numerical", "neuroevolution", "supervised", "evoxbench"]

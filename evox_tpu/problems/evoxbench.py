@@ -1,0 +1,93 @@
+"""EvoXBench NAS benchmark wrappers (reference
+src/evox/problems/evoxbench/evoxbench.py:20-75).
+
+The external ``evoxbench`` package hosts the benchmark databases; its
+``evaluate`` is noisy, so the call goes through ``io_callback`` (ordered
+host effect) with an explicit seed drawn from the problem's key — exactly
+the reference's scheme. Import-guarded: constructing any of these without
+``evoxbench`` installed raises ImportError with guidance.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core.problem import Problem
+
+
+def _evaluate_with_seed(benchmark, seed, pop):
+    np.random.seed(int(np.asarray(seed).ravel()[0]))
+    return benchmark.evaluate(np.asarray(pop)).astype(np.float32)
+
+
+class EvoXBenchProblem(Problem):
+    """Wrap an ``evoxbench`` benchmark object as a Problem."""
+
+    def __init__(self, benchmark):
+        self.benchmark = benchmark
+        self.n_objs = benchmark.evaluator.n_objs
+        self.lb = jnp.asarray(benchmark.search_space.lb, dtype=jnp.float32)
+        self.ub = jnp.asarray(benchmark.search_space.ub, dtype=jnp.float32)
+        self._evaluate = partial(_evaluate_with_seed, benchmark)
+
+    def fit_shape(self, pop_size: int) -> Tuple[int, ...]:
+        return (pop_size, self.n_objs)
+
+    def init(self, key=None):
+        return key if key is not None else jax.random.PRNGKey(0)
+
+    def evaluate(self, state, pop):
+        key, k_seed = jax.random.split(state)
+        seed = jax.random.randint(k_seed, (1,), 0, 2**31 - 1)
+        fitness = io_callback(
+            self._evaluate,
+            jax.ShapeDtypeStruct((pop.shape[0], self.n_objs), jnp.float32),
+            seed,
+            pop,
+            ordered=True,
+        )
+        return fitness, key
+
+
+def _load_suite(name: str):
+    try:
+        from evoxbench import test_suites  # pragma: no cover - optional dep
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "the `evoxbench` package (and its benchmark databases) is "
+            "required for NAS benchmark problems"
+        ) from e
+    return getattr(test_suites, name)  # pragma: no cover
+
+
+class C10MOP(EvoXBenchProblem):
+    """CIFAR-10 NAS multi-objective problems 1-9."""
+
+    def __init__(self, problem_id: int):
+        if not (isinstance(problem_id, int) and 1 <= problem_id <= 9):
+            raise ValueError("C10MOP problem_id must be an int in [1, 9]")
+        super().__init__(_load_suite("c10mop")(problem_id))
+
+
+class CitySegMOP(EvoXBenchProblem):
+    """Cityscapes segmentation NAS problems 1-15."""
+
+    def __init__(self, problem_id: int):
+        if not (isinstance(problem_id, int) and 1 <= problem_id <= 15):
+            raise ValueError("CitySegMOP problem_id must be an int in [1, 15]")
+        super().__init__(_load_suite("citysegmop")(problem_id))
+
+
+class IN1kMOP(EvoXBenchProblem):
+    """ImageNet-1k NAS problems 1-9."""
+
+    def __init__(self, problem_id: int):
+        if not (isinstance(problem_id, int) and 1 <= problem_id <= 9):
+            raise ValueError("IN1kMOP problem_id must be an int in [1, 9]")
+        super().__init__(_load_suite("in1kmop")(problem_id))

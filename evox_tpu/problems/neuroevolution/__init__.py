@@ -7,9 +7,16 @@ from .rollout import (
 )
 from .policy import mlp_policy
 from .control import envs
+from .hostenv import HostEnvProblem, HostVectorEnv, NumpyCartPoleVec, envpool_make
+from .rollout_farm import HostRolloutFarm
 
 __all__ = [
     "Trajectory",
+    "HostEnvProblem",
+    "HostVectorEnv",
+    "NumpyCartPoleVec",
+    "envpool_make",
+    "HostRolloutFarm",
     "CapEpisode",
     "ObsNormalizer",
     "PolicyRolloutProblem",

@@ -1,0 +1,210 @@
+"""Host rollout farm — CPU-side episode parallelism for non-jittable
+simulators (gymnasium-style envs with a Python ``reset``/``step`` API).
+
+Capability parity with the reference's Ray-based Gym problem
+(src/evox/problems/neuroevolution/reinforcement_learning/gym.py:59-264):
+``Controller`` + ``Worker`` actors become a thread pool of workers, each
+owning a slice of environments. Two policy placements, mirroring the
+reference:
+
+- ``batch_policy=True`` (default, the TPU-appropriate mode, ref
+  _batched_evaluate:210-258): every step gathers observations from all
+  workers, runs ONE vmapped policy forward for the whole population on the
+  accelerator, and scatters actions back to the workers. The policy never
+  leaves the device; only obs/actions cross the boundary.
+- ``batch_policy=False`` (ref rollout:120-139): each worker loops its own
+  episodes to completion with a per-worker jitted policy — no global
+  lockstep, better when episode lengths vary wildly and the policy is tiny.
+
+Threads (not processes) are the right host-parallelism unit here: env
+``step`` bodies are numpy/C code that releases the GIL, and policy
+inference happens in JAX either way. No object store, no serialization.
+
+Multi-objective support via ``mo_keys`` pulled from the env ``info`` dict
+(ref gym.py:83-94). Adaptive episode capping via ``cap_episode``
+(ref CapEpisode, gym.py:267-281) — host-side state, updated per generation.
+
+This problem is NOT jittable (``jittable = False``): run it through the
+workflow's ``pure_callback`` path (``StdWorkflow(..., external_problem=
+True)`` is implied automatically).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.problem import Problem
+
+
+class _Worker:
+    """Owns a slice of environments and their episode bookkeeping."""
+
+    def __init__(self, env_creator: Callable, mo_keys: Sequence[str]):
+        self.env_creator = env_creator
+        self.mo_keys = tuple(mo_keys)
+        self.envs: list = []
+
+    def reset(self, seed: int, num_env: int) -> np.ndarray:
+        while len(self.envs) < num_env:
+            self.envs.append(self.env_creator())
+        self.n = num_env
+        self.total_rewards = np.zeros((num_env,))
+        self.acc_mo = np.zeros((num_env, len(self.mo_keys)))
+        self.episode_length = np.zeros((num_env,))
+        self.done = np.zeros((num_env,), dtype=bool)
+        obs, self.infos = zip(
+            *[env.reset(seed=seed + i) for i, env in enumerate(self.envs[:num_env])]
+        )
+        self.observations = list(obs)
+        return np.stack(self.observations)
+
+    def step(self, actions: np.ndarray) -> Tuple[np.ndarray, bool]:
+        for i, env in enumerate(self.envs[: self.n]):
+            if self.done[i]:
+                continue
+            obs, reward, terminated, truncated, info = env.step(actions[i])
+            self.observations[i] = obs
+            self.total_rewards[i] += reward
+            self.episode_length[i] += 1
+            self.done[i] = terminated or truncated
+            for j, k in enumerate(self.mo_keys):
+                if k not in info:
+                    raise KeyError(
+                        f"mo_keys has {k!r}, not in env info "
+                        f"(available: {list(info.keys())})"
+                    )
+                self.acc_mo[i, j] += info[k]
+        return np.stack(self.observations), bool(self.done.all())
+
+    def rollout(
+        self, policy_fn: Callable, subpop: Any, seed: int, cap: Optional[int]
+    ) -> None:
+        """Independent episode loop with a local policy (batch_policy=False)."""
+        self.reset(seed, _tree_batch_size(subpop))
+        steps = 0
+        while not self.done.all():
+            actions = np.asarray(policy_fn(subpop, jnp.asarray(np.stack(self.observations))))
+            self.step(actions)
+            steps += 1
+            if cap is not None and steps >= cap:
+                break
+
+    def results(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        return self.total_rewards, self.acc_mo, self.episode_length
+
+
+def _tree_batch_size(tree: Any) -> int:
+    return jax.tree.leaves(tree)[0].shape[0]
+
+
+def _tree_split(tree: Any, n: int) -> list:
+    """Split every leaf's leading axis into n near-even chunks, transposed
+    to a list of sub-pytrees (ref gym.py slice_pop:166-183)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    chunks = [np.array_split(np.asarray(leaf), n, axis=0) for leaf in leaves]
+    return [treedef.unflatten([c[i] for c in chunks]) for i in range(n)]
+
+
+class HostRolloutFarm(Problem):
+    jittable = False
+
+    def __init__(
+        self,
+        policy: Callable,
+        env_creator: Callable,
+        num_workers: int = 4,
+        mo_keys: Sequence[str] = (),
+        batch_policy: bool = True,
+        cap_episode: Optional[int] = None,
+        adaptive_cap: bool = False,
+    ):
+        self.policy = policy
+        self.batched_policy = jax.jit(jax.vmap(policy))
+        self.num_workers = num_workers
+        self.mo_keys = tuple(mo_keys)
+        self.batch_policy = batch_policy
+        self.cap = cap_episode
+        self.adaptive_cap = adaptive_cap
+        self.workers = [_Worker(env_creator, mo_keys) for _ in range(num_workers)]
+        self.pool = ThreadPoolExecutor(max_workers=num_workers)
+        # Host-side RNG for episode seeds: the workflow's pure_callback path
+        # deliberately discards the device-side problem state (std.py:186),
+        # so generation-to-generation seed variation must live on this object.
+        self._seed_rng = np.random.default_rng()
+
+    def fit_shape(self, pop_size: int) -> Tuple[int, ...]:
+        if self.mo_keys:
+            return (pop_size, len(self.mo_keys))
+        return (pop_size,)
+
+    def init(self, key=None):
+        return key if key is not None else jax.random.PRNGKey(0)
+
+    def evaluate(self, state, pop):
+        seed = int(self._seed_rng.integers(0, np.iinfo(np.int32).max))
+        pop_size = _tree_batch_size(pop)
+        n_active = min(self.num_workers, pop_size)  # never hand a worker 0 envs
+        workers = self.workers[:n_active]
+        subpops = _tree_split(pop, n_active)
+        sizes = [_tree_batch_size(s) for s in subpops]
+
+        if self.batch_policy:
+            rewards, mo, lengths = self._lockstep(pop, workers, subpops, sizes, seed)
+        else:
+            futures = [
+                self.pool.submit(
+                    w.rollout, self.batched_policy, sp, seed + 7919 * i, self.cap
+                )
+                for i, (w, sp) in enumerate(zip(workers, subpops))
+            ]
+            for f in futures:
+                f.result()
+            rewards, mo, lengths = self._gather(workers)
+
+        if self.adaptive_cap:
+            # next generation's cap = 2x the measured mean episode length
+            # (reference CapEpisode, gym.py:267-281)
+            self.cap = max(int(2.0 * float(np.mean(lengths))), 1)
+
+        if self.mo_keys:
+            return jnp.asarray(mo, dtype=jnp.float32), state
+        return jnp.asarray(rewards, dtype=jnp.float32), state
+
+    def _lockstep(self, pop, workers, subpops, sizes, seed):
+        obs = list(
+            self.pool.map(
+                lambda wi: workers[wi[0]].reset(seed + 7919 * wi[0], wi[1]),
+                enumerate(sizes),
+            )
+        )
+        steps = 0
+        while True:
+            all_obs = jnp.asarray(np.concatenate(obs, axis=0), dtype=jnp.float32)
+            actions = np.asarray(self.batched_policy(pop, all_obs))
+            action_slices = np.split(actions, np.cumsum(sizes)[:-1], axis=0)
+            outs = list(
+                self.pool.map(
+                    lambda wa: wa[0].step(wa[1]),
+                    zip(workers, action_slices),
+                )
+            )
+            obs = [o for o, _ in outs]
+            steps += 1
+            if all(done for _, done in outs):
+                break
+            if self.cap is not None and steps >= self.cap:
+                break
+        return self._gather(workers)
+
+    def _gather(self, workers):
+        rewards, mo, lengths = zip(*[w.results() for w in workers])
+        return (
+            np.concatenate(rewards),
+            np.concatenate(mo),
+            np.concatenate(lengths),
+        )

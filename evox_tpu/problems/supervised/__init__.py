@@ -1,0 +1,3 @@
+from .dataset import DatasetProblem, InMemoryDataLoader, TensorflowDataset
+
+__all__ = ["DatasetProblem", "InMemoryDataLoader", "TensorflowDataset"]

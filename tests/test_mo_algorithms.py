@@ -114,3 +114,34 @@ def test_sra_dtlz2_igd():
 def test_lmocso_dtlz2_igd():
     algo = LMOCSO(LB, UB, n_objs=M, pop_size=100, max_gen=100)
     assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_ibea_dtlz2_igd():
+    algo = IBEA(LB, UB, n_objs=M, pop_size=100)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_hype_dtlz2_igd():
+    algo = HypE(LB, UB, n_objs=M, pop_size=100)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_knea_dtlz2_igd():
+    algo = KnEA(LB, UB, n_objs=M, pop_size=100)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_bige_zdt1_igd():
+    zdt_dim = 12
+    algo = BiGE(jnp.zeros(zdt_dim), jnp.ones(zdt_dim), n_objs=2, pop_size=100)
+    assert _igd_after(algo, ZDT1(n_dim=zdt_dim), 200) < 0.05
+
+
+def test_knea_adaptive_radius_updates():
+    """KnEA's adaptive (r, t) state must move off its init values."""
+    algo = KnEA(LB, UB, n_objs=M, pop_size=64)
+    wf = StdWorkflow(algo, DTLZ2(d=DIM, m=M))
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.run(state, 5)
+    assert float(state.algo.r) != 1.0
+    assert bool(jnp.any(state.algo.knee))
