@@ -20,7 +20,7 @@ from .core import (
     create_mesh,
     POP_AXIS,
 )
-from . import algorithms, core, monitors, operators, problems, utils, workflows
+from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
 from .workflows import StdWorkflow
 
 __all__ = [
@@ -40,5 +40,7 @@ __all__ = [
     "operators",
     "problems",
     "utils",
+    "vis_tools",
+    "metrics",
     "workflows",
 ]

@@ -16,17 +16,22 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import PartitionSpec as P
+
 from ...core.algorithm import Algorithm
-from ...core.struct import PyTreeNode
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
 
 
 class MOState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array  # (pop, m)
-    offspring: jax.Array
-    key: jax.Array
+    # per-field mesh layout (core.distributed.state_sharding): population
+    # arrays shard over "pop"; the rng key replicates
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))  # (pop, m)
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 def uniform_init(key: jax.Array, lb: jax.Array, ub: jax.Array, pop_size: int) -> jax.Array:

@@ -14,18 +14,24 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import PartitionSpec as P
+
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 
 
 class CSOState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    velocity: jax.Array
-    students: jax.Array  # indices of the losers just proposed
-    candidates: jax.Array
-    candidate_velocity: jax.Array
-    key: jax.Array
+    # per-field mesh layout (consumed by core.distributed.state_sharding /
+    # the workflow's constrain_state): population-leading arrays shard over
+    # the "pop" axis, everything else replicates
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    velocity: jax.Array = field(sharding=P(POP_AXIS))
+    students: jax.Array = field(sharding=P())  # half-pop indices: replicate
+    candidates: jax.Array = field(sharding=P(POP_AXIS))
+    candidate_velocity: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class CSO(Algorithm):

@@ -12,18 +12,22 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from jax.sharding import PartitionSpec as P
+
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 
 
 class PSOState(PyTreeNode):
-    population: jax.Array
-    velocity: jax.Array
-    pbest_position: jax.Array
-    pbest_fitness: jax.Array
-    gbest_position: jax.Array
-    gbest_fitness: jax.Array
-    key: jax.Array
+    # per-field mesh layout annotations (see core.distributed.state_sharding)
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    velocity: jax.Array = field(sharding=P(POP_AXIS))
+    pbest_position: jax.Array = field(sharding=P(POP_AXIS))
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    gbest_position: jax.Array = field(sharding=P())
+    gbest_fitness: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class PSO(Algorithm):

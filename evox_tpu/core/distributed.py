@@ -32,7 +32,11 @@ __all__ = [
     "pop_sharding",
     "replicated_sharding",
     "shard_pop",
+    "place_pop",
     "replicate",
+    "state_sharding",
+    "constrain_state",
+    "place_state",
     "all_gather",
     "tree_all_gather",
     "init_distributed",
@@ -85,6 +89,81 @@ def replicate(tree: Any, mesh: Optional[Mesh]) -> Any:
     if mesh is None:
         return tree
     return _constrain(tree, replicated_sharding(mesh))
+
+
+def _spec_for_path(state: Any, path: tuple, default: "P") -> "P":
+    """Resolve the deepest ``field(sharding=...)`` annotation along a pytree
+    key path (inner annotations override outer ones)."""
+    import dataclasses
+
+    obj, spec = state, default
+    for key in path:
+        if isinstance(key, jax.tree_util.GetAttrKey) and dataclasses.is_dataclass(obj):
+            f = obj.__dataclass_fields__.get(key.name)
+            if f is not None and f.metadata.get("sharding") is not None:
+                spec = f.metadata["sharding"]
+            obj = getattr(obj, key.name)
+        elif isinstance(key, jax.tree_util.SequenceKey):
+            obj = obj[key.idx]
+        elif isinstance(key, jax.tree_util.DictKey):
+            obj = obj[key.key]
+        else:
+            break
+    return spec
+
+
+def state_sharding(state: Any, mesh: Mesh, default: Optional["P"] = None) -> Any:
+    """A pytree of ``NamedSharding`` matching ``state``, driven by the
+    ``field(sharding=...)`` annotations on its dataclasses (unannotated
+    fields get ``default``, replicated unless overridden).
+
+    This is the consumer the reference's sharding metadata never had
+    (reference state.py:304-334 ``get_state_sharding`` exists but
+    StdWorkflow ignores it): feed the result to ``jax.device_put``,
+    ``with_sharding_constraint`` or jit's ``in_shardings``.
+    """
+    default = P() if default is None else default
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _spec_for_path(state, path, default)),
+        state,
+    )
+
+
+def constrain_state(state: Any, mesh: Optional[Mesh]) -> Any:
+    """Tracing-time: constrain ANNOTATED leaves to their declared sharding.
+
+    Unannotated leaves are left to GSPMD's propagation (constraining them
+    to replicated would pessimize algorithms whose working arrays are
+    naturally population-sharded)."""
+    if mesh is None:
+        return state
+
+    def constrain(path, x):
+        spec = _spec_for_path(state, path, None)
+        if spec is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(constrain, state)
+
+
+def place_state(state: Any, mesh: Optional[Mesh]) -> Any:
+    """Eager: ``device_put`` every leaf onto its annotated sharding."""
+    if mesh is None:
+        return state
+    shardings = state_sharding(state, mesh)
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def place_pop(tree: Any, mesh: Optional[Mesh], axis_name: str = POP_AXIS) -> Any:
+    """EAGER placement: ``device_put`` every leaf with its leading axis
+    sharded over ``axis_name``. Use when loading host data or a restored
+    checkpoint into a mesh layout (``shard_pop`` is the tracing-time
+    constraint form and only works inside jit)."""
+    if mesh is None:
+        return tree
+    s = pop_sharding(mesh, axis_name)
+    return jax.tree.map(lambda x: jax.device_put(x, s), tree)
 
 
 def all_gather(x: jax.Array, axis_name: str = POP_AXIS, tiled: bool = True) -> jax.Array:

@@ -28,11 +28,25 @@ __all__ = [
 ]
 
 
-def field(*, static: bool = False, **kwargs: Any) -> dataclasses.Field:
+def field(
+    *, static: bool = False, sharding: Any = None, **kwargs: Any
+) -> dataclasses.Field:
     """A dataclass field; ``static=True`` marks it as pytree metadata
-    (hashable aux data, not traced)."""
+    (hashable aux data, not traced).
+
+    ``sharding``: an optional ``jax.sharding.PartitionSpec`` declaring how
+    this field's arrays lay out over the workflow mesh (e.g.
+    ``P("pop")`` for population-leading arrays). Unannotated fields default
+    to replicated. Consumed by
+    :func:`evox_tpu.core.distributed.state_sharding` and applied by the
+    workflow each step — this makes the annotation the single source of
+    truth for state layout (the reference declared the same idea but never
+    consumed it; reference core/pytree_dataclass.py:12-19, SURVEY §2.3).
+    """
     metadata = dict(kwargs.pop("metadata", {}) or {})
     metadata["static"] = static
+    if sharding is not None:
+        metadata["sharding"] = sharding
     return dataclasses.field(metadata=metadata, **kwargs)
 
 

@@ -1,3 +1,15 @@
 from .eval_monitor import EvalMonitor, EvalMonitorState
+from .pop_monitor import PopMonitor
+from .evoxvis_monitor import EvoXVisMonitor
+from .profiler import StepTimerMonitor, trace as profiler_trace
+from . import profiler
 
-__all__ = ["EvalMonitor", "EvalMonitorState"]
+__all__ = [
+    "EvalMonitor",
+    "EvalMonitorState",
+    "PopMonitor",
+    "EvoXVisMonitor",
+    "StepTimerMonitor",
+    "profiler_trace",
+    "profiler",
+]

@@ -1,0 +1,14 @@
+"""Shared monitor plumbing."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import SingleDeviceSharding
+
+
+def host0_sharding() -> SingleDeviceSharding:
+    """Sharding that pins a host callback to GLOBAL device 0 — on a
+    multi-host mesh the callback then fires on process 0 only (the process
+    that owns device 0), the same discipline as the reference
+    (eval_monitor.py:69 ``SingleDeviceSharding(jax.devices()[0])``)."""
+    return SingleDeviceSharding(jax.devices()[0])

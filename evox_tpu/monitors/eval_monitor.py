@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import io_callback
 
 from ..core.monitor import Monitor
+from .common import host0_sharding
 from ..core.struct import PyTreeNode
 from ..operators.selection.non_dominate import non_dominate
 
@@ -79,7 +80,14 @@ class EvalMonitor(Monitor):
                 self.solution_history.append(sol)
             return jnp.zeros((), dtype=jnp.int32)
 
-        io_callback(append, jax.ShapeDtypeStruct((), jnp.int32), fitness, cand, ordered=True)
+        io_callback(
+            append,
+            jax.ShapeDtypeStruct((), jnp.int32),
+            fitness,
+            cand,
+            sharding=host0_sharding(),
+            ordered=True,
+        )
 
     def _update_so(self, mstate, cand, fitness):
         key_fit = fitness * self.opt_direction[0]  # minimize internally

@@ -1,0 +1,198 @@
+"""EvoXVisMonitor — stream generations to Apache Arrow IPC files for the
+EvoXVis GUI (reference src/evox/monitors/evoxvis_monitor.py:60-224).
+
+Same wire format as the reference so the external EvoXVis tool can read
+either: one record batch per ``batch_size`` generations, columns
+``generation`` (uint64), ``fitness`` (fixed-width binary of the raw array
+bytes), optional ``population``, optional ``duration`` (seconds since the
+run began) and one float64 column per metric; array dtype/population-size
+recorded as schema metadata. Schema is inferred at the first write so the
+binary widths are exact.
+
+Device side, this is a ``post_eval`` hook shipping (cand, fitness) out via
+ordered ``io_callback`` — the jitted step never blocks on the file.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core.monitor import Monitor
+from .common import host0_sharding
+
+
+class EvoXVisMonitor(Monitor):
+    """Args:
+        base_filename: output files are ``<base>_<i>.arrow`` in ``out_dir``
+            (``i`` = first unused index).
+        out_dir: defaults to ``./evox_vis``.
+        batch_size: generations per Arrow record batch.
+        record_population: also store decision-space arrays.
+        record_time: store per-generation wall-clock offsets.
+        compression: ``None`` | ``"lz4"`` | ``"zstd"``.
+    """
+
+    def __init__(
+        self,
+        base_filename: str = "evox",
+        out_dir: Optional[str] = None,
+        batch_size: int = 64,
+        record_population: bool = False,
+        record_time: bool = True,
+        compression: Optional[str] = None,
+    ):
+        import pyarrow as pa
+
+        self.pa = pa
+        base = Path(out_dir) if out_dir is not None else Path("evox_vis")
+        base.mkdir(parents=True, exist_ok=True)
+        i = 0
+        while (base / f"{base_filename}_{i}.arrow").exists():
+            i += 1
+        self.path = base / f"{base_filename}_{i}.arrow"
+        self.sink = pa.OSFile(str(self.path), "wb")
+        self.batch_size = batch_size
+        self.record_population = record_population
+        self.record_time_enabled = record_time
+        self.compression = compression
+
+        self.schema = None
+        self.writer = None
+        self.is_closed = False
+        self.generation_counter = 0
+        self.generations: list = []
+        self.fitness: list = []
+        self.population: list = []
+        self.duration: list = []
+        self.fitness_meta = None  # (dtype str, pop_size)
+        self.population_dtype = None
+        self.start_time = None
+        self.ref_time = None
+
+    def hooks(self):
+        return ("post_eval",)
+
+    def post_eval(self, mstate: Any, cand: Any, fitness: jax.Array) -> Any:
+        if self.record_population:
+            pop_arr = jax.tree.leaves(cand)[0]
+            io_callback(
+                self._record,
+                None,
+                pop_arr,
+                fitness,
+                sharding=host0_sharding(),
+                ordered=True,
+            )
+        else:
+            io_callback(
+                self._record_fit_only,
+                None,
+                fitness,
+                sharding=host0_sharding(),
+                ordered=True,
+            )
+        return mstate
+
+    # ---------------------------------------------------------------- host side
+    def _record_fit_only(self, fitness):
+        self._append(None, np.asarray(fitness))
+
+    def _record(self, population, fitness):
+        self._append(np.asarray(population), np.asarray(fitness))
+
+    def _append(self, population, fitness):
+        if self.is_closed:
+            return  # the workflow may keep stepping after close(); drop quietly
+        if self.record_time_enabled:
+            if self.start_time is None:
+                self.start_time = time.time()
+                self.ref_time = time.monotonic()
+            self.duration.append(time.monotonic() - self.ref_time)
+        self.generations.append(self.generation_counter)
+        self.generation_counter += 1
+        self.fitness.append(fitness.tobytes())
+        self.fitness_meta = (str(fitness.dtype), fitness.shape[0])
+        if population is not None:
+            self.population.append(population.tobytes())
+            self.population_dtype = str(population.dtype)
+        if len(self.fitness) >= self.batch_size:
+            self._write_batch()
+
+    def _build_schema(self):
+        # variable-length binary, not pa.binary(n): algorithms with
+        # init_ask/init_tell (e.g. CSO) evaluate a different candidate count
+        # on the first generation, so row byte-lengths legitimately vary
+        pa = self.pa
+        fields = [
+            ("generation", pa.uint64()),
+            ("fitness", pa.binary()),
+        ]
+        metadata = {
+            "population_size": str(self.fitness_meta[1]),
+            "fitness_dtype": self.fitness_meta[0],
+        }
+        if self.population:
+            fields.append(("population", pa.binary()))
+            metadata["population_dtype"] = self.population_dtype
+        if self.duration:
+            fields.append(("duration", pa.float64()))
+            metadata["begin_time"] = str(self.start_time)
+        self.schema = pa.schema(fields, metadata=metadata)
+        self.writer = pa.ipc.new_file(
+            self.sink,
+            self.schema,
+            options=pa.ipc.IpcWriteOptions(compression=self.compression),
+        )
+
+    def _write_batch(self):
+        if not self.fitness:
+            return
+        if self.schema is None:
+            self._build_schema()
+        n = len(self.fitness)
+        cols = [self.generations[:n], self.fitness[:n]]
+        if self.population:
+            cols.append(self.population[:n])
+            self.population = self.population[n:]
+        if self.duration:
+            cols.append(self.duration[:n])
+            self.duration = self.duration[n:]
+        self.writer.write_batch(self.pa.record_batch(cols, schema=self.schema))
+        self.generations = self.generations[n:]
+        self.fitness = self.fitness[n:]
+
+    def flush(self):
+        jax.effects_barrier()
+        self._write_batch()
+
+    def close(self, flush: bool = True):
+        if self.is_closed:
+            return
+        try:
+            if flush:
+                self.flush()
+        finally:
+            # even if the flush raises, finalize the Arrow footer so the
+            # file stays readable, and only then mark closed
+            self.is_closed = True
+            if self.writer is not None:
+                self.writer.close()
+            self.sink.close()
+
+    def __del__(self):
+        try:  # interpreter teardown may have cleared module globals
+            if not self.is_closed:
+                warnings.warn(
+                    "EvoXVisMonitor was garbage-collected without close(); "
+                    "trailing generations were not flushed"
+                )
+                self.close(flush=False)
+        except Exception:
+            pass

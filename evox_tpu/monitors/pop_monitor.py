@@ -1,0 +1,105 @@
+"""PopMonitor — record the algorithm's full population/fitness every
+generation (reference src/evox/monitors/pop_monitor.py:54-71).
+
+The recording is an ``io_callback`` out of the jitted step (host-side
+history is unbounded, so it cannot live in the on-device monitor state),
+pinned to one device like the reference. Use ``fitness_only=True`` to skip
+the decision-space arrays when only objective-space trajectories matter.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import io_callback
+
+from ..core.monitor import Monitor
+from .common import host0_sharding
+
+
+class PopMonitor(Monitor):
+    def __init__(
+        self,
+        population_name: str = "population",
+        fitness_name: str = "fitness",
+        fitness_only: bool = False,
+    ):
+        self.population_name = population_name
+        self.fitness_name = fitness_name
+        self.fitness_only = fitness_only
+        self.population_history: list = []
+        self.fitness_history: list = []
+
+    def hooks(self):
+        return ("post_step",)
+
+    def post_step(self, mstate: Any, wf_state: Any) -> Any:
+        fitness = getattr(wf_state.algo, self.fitness_name)
+        if self.fitness_only:
+            io_callback(
+                self._record_fit,
+                None,
+                fitness,
+                sharding=host0_sharding(),
+                ordered=True,
+            )
+        else:
+            population = getattr(wf_state.algo, self.population_name)
+            io_callback(
+                self._record,
+                None,
+                population,
+                fitness,
+                sharding=host0_sharding(),
+                ordered=True,
+            )
+        return mstate
+
+    def _record(self, population, fitness):
+        self.population_history.append(population)
+        self.fitness_history.append(fitness)
+
+    def _record_fit(self, fitness):
+        self.fitness_history.append(fitness)
+
+    # --------------------------------------------------------------- getters
+    def get_latest_fitness(self):
+        self.flush()
+        return self.fitness_history[-1]
+
+    def get_latest_population(self):
+        self.flush()
+        return self.population_history[-1]
+
+    def get_population_history(self):
+        self.flush()
+        return self.population_history
+
+    def get_fitness_history(self):
+        self.flush()
+        return self.fitness_history
+
+    def plot(self, problem_pf: Optional[Any] = None, **kwargs):
+        """Objective-space animation over generations (vis_tools)."""
+        self.flush()
+        if not self.fitness_history:
+            warnings.warn("no fitness history recorded, returning None")
+            return None
+        from ..vis_tools import plot
+
+        n_objs = (
+            1
+            if self.fitness_history[0].ndim == 1
+            else self.fitness_history[0].shape[1]
+        )
+        if n_objs == 1:
+            return plot.plot_obj_space_1d(self.fitness_history, **kwargs)
+        if n_objs == 2:
+            return plot.plot_obj_space_2d(self.fitness_history, problem_pf, **kwargs)
+        if n_objs == 3:
+            return plot.plot_obj_space_3d(self.fitness_history, problem_pf, **kwargs)
+        warnings.warn(f"plotting {n_objs}-objective space is not supported")
+        return None

@@ -1,3 +1,3 @@
 from . import envs
 from .envs import EnvSpec, acrobot, cartpole, make, mountain_car, pendulum
-
+from .brax_adapter import brax_env

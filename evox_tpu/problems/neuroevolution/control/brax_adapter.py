@@ -1,0 +1,65 @@
+"""Brax -> EnvSpec adapter (reference src/evox/problems/neuroevolution/
+reinforcement_learning/brax.py:45-97).
+
+Brax physics is pure JAX, so a brax environment drops straight into
+:class:`~evox_tpu.problems.neuroevolution.rollout.PolicyRolloutProblem`'s
+double-vmap while_loop — the adapter only reshapes the API into the
+``(reset, obs, step)`` triple. No VmapWrapper is needed: the rollout
+problem vmaps the spec itself over (pop, episodes), which keeps the env
+state sharded along the ``pop`` mesh axis instead of replicated (SURVEY.md
+§7 "Brax-on-TPU memory layout").
+
+Import-guarded: brax is optional and not part of this build's baked
+dependencies.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .envs import EnvSpec
+
+
+def brax_env(
+    env_name: str,
+    backend: str = "generalized",
+    max_steps: int = 1000,
+    terminate_on_done: bool = True,
+) -> EnvSpec:
+    """Wrap a brax environment as an :class:`EnvSpec`.
+
+    Example::
+
+        env = brax_env("halfcheetah", backend="positional")
+        problem = PolicyRolloutProblem(policy, env, num_episodes=4)
+    """
+    try:
+        from brax import envs as brax_envs  # pragma: no cover - optional dep
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "brax is not installed; use the built-in pure-JAX control envs "
+            "(evox_tpu.problems.neuroevolution.control.envs) instead"
+        ) from e
+
+    env = brax_envs.get_environment(env_name=env_name, backend=backend)  # pragma: no cover
+
+    def reset(key):  # pragma: no cover - exercised only with brax installed
+        return env.reset(key)
+
+    def obs(state):  # pragma: no cover
+        return state.obs
+
+    def step(state, action):  # pragma: no cover
+        new_state = env.step(state, action)
+        done = new_state.done.astype(bool) if terminate_on_done else False
+        return new_state, new_state.reward, done
+
+    return EnvSpec(  # pragma: no cover
+        reset=reset,
+        obs=obs,
+        step=step,
+        obs_dim=env.observation_size,
+        act_dim=env.action_size,
+        discrete=False,
+        max_steps=max_steps,
+    )

@@ -10,6 +10,7 @@ from .common import (
     cos_dist,
     dominate_relation,
     new_key,
+    frames2gif,
 )
 from .aggregation import AggregationFunction
 from .optimizers import clipup, make_optimizer
@@ -25,6 +26,7 @@ __all__ = [
     "pairwise_chebyshev_dist",
     "cos_dist",
     "dominate_relation",
+    "frames2gif",
     "new_key",
     "AggregationFunction",
     "clipup",

@@ -135,3 +135,30 @@ def new_key(key: jax.Array):
     """Split a key, returning (carry_key, use_key)."""
     k1, k2 = jax.random.split(key)
     return k1, k2
+
+
+def frames2gif(frames, save_path: str, duration: float = 0.1) -> None:
+    """Write a list of (H, W, 3) uint8 frames to an animated GIF (reference
+    utils/common.py:248-261). Uses imageio when present, else PIL."""
+    import numpy as _np
+
+    arrs = [_np.asarray(f, dtype=_np.uint8) for f in frames]
+    try:
+        import imageio
+
+        with imageio.get_writer(save_path, mode="I", duration=duration) as w:
+            for a in arrs:
+                w.append_data(a)
+        return
+    except ImportError:
+        pass
+    from PIL import Image
+
+    imgs = [Image.fromarray(a) for a in arrs]
+    imgs[0].save(
+        save_path,
+        save_all=True,
+        append_images=imgs[1:],
+        duration=int(duration * 1000),
+        loop=0,
+    )

@@ -1,0 +1,15 @@
+from . import plot
+from .plot import (
+    plot_dec_space,
+    plot_obj_space_1d,
+    plot_obj_space_2d,
+    plot_obj_space_3d,
+)
+
+__all__ = [
+    "plot",
+    "plot_dec_space",
+    "plot_obj_space_1d",
+    "plot_obj_space_2d",
+    "plot_obj_space_3d",
+]

@@ -34,7 +34,12 @@ from ..core.algorithm import Algorithm
 from ..core.monitor import Monitor
 from ..core.problem import Problem
 from ..core.struct import PyTreeNode, static_field, field
-from ..core.distributed import shard_pop
+from ..core.distributed import (
+    POP_AXIS as _POP_AXIS_NAME,
+    all_gather,
+    constrain_state,
+    shard_pop,
+)
 from ..utils.common import parse_opt_direction
 
 
@@ -66,6 +71,19 @@ class StdWorkflow:
             defaults to ``not problem.jittable``.
         num_objectives: fitness arity used to declare callback output shapes.
         jit_step: disable to debug eagerly.
+        eval_shard_map: evaluate inside an explicit ``jax.shard_map`` island
+            — each device scores only its population shard, then the fitness
+            is ``all_gather``-ed (tiled) over ICI. Semantically identical to
+            the default GSPMD-constraint path (asserted in tests) but the
+            collective is explicit; useful when XLA's auto-partitioning of an
+            exotic ``evaluate`` is poor. Requires a mesh, a jittable problem
+            and a problem state that is replicated-safe (stateless or pure).
+        allow_uneven_shards: with a mesh, a population not divisible by the
+            ``"pop"`` axis size normally raises at construction (uneven GSPMD
+            layouts silently unbalance devices; the reference hard-errors
+            too, std_workflow.py:189-193). Set True to accept the uneven
+            layout anyway (GSPMD pads internally; shard_map mode still
+            requires divisibility).
     """
 
     def __init__(
@@ -80,6 +98,8 @@ class StdWorkflow:
         external_problem: Optional[bool] = None,
         num_objectives: int = 1,
         jit_step: bool = True,
+        eval_shard_map: bool = False,
+        allow_uneven_shards: bool = False,
     ):
         self.algorithm = algorithm
         self.problem = problem
@@ -90,6 +110,22 @@ class StdWorkflow:
         self.mesh = mesh
         self.num_objectives = num_objectives
         self.external = (not problem.jittable) if external_problem is None else external_problem
+        self.eval_shard_map = eval_shard_map
+        if eval_shard_map and (mesh is None or self.external):
+            raise ValueError(
+                "eval_shard_map requires a mesh and a jittable problem"
+            )
+        if mesh is not None:
+            n_shards = mesh.shape[_POP_AXIS_NAME]
+            pop_size = getattr(algorithm, "pop_size", None)
+            if pop_size is not None and pop_size % n_shards != 0:
+                if eval_shard_map or not allow_uneven_shards:
+                    raise ValueError(
+                        f"pop_size {pop_size} is not divisible by the mesh's "
+                        f"'pop' axis ({n_shards} shards); pad the population, "
+                        "resize the mesh, or pass allow_uneven_shards=True "
+                        "to accept an unbalanced GSPMD layout"
+                    )
         for m in self.monitors:
             m.set_opt_direction(self.opt_direction)
         self._hook_table = {
@@ -164,6 +200,8 @@ class StdWorkflow:
 
     def _evaluate(self, pstate: Any, cand: Any) -> Tuple[jax.Array, Any]:
         if not self.external:
+            if self.eval_shard_map:
+                return self._evaluate_shard_map(pstate, cand)
             return self.problem.evaluate(pstate, cand)
         # Host-side problem via pure_callback with a declared output signature.
         # The problem state is passed through the callback as an operand (it
@@ -185,6 +223,42 @@ class StdWorkflow:
 
         fitness = jax.pure_callback(host_eval, result_sds, pstate, cand)
         return fitness, pstate
+
+    def _evaluate_shard_map(self, pstate: Any, cand: Any) -> Tuple[jax.Array, Any]:
+        """Explicit-collective evaluation: each device scores its local
+        population shard, then all-gathers the fitness over ICI (the
+        modernized form of the reference's per-rank dynamic_slice +
+        lax.all_gather pmap scheme, std_workflow.py:160,189-200). The
+        problem state is replicated in and must come back replicated —
+        every shard computes the same update or none."""
+        from jax.sharding import PartitionSpec as P
+
+        n_cand = jax.tree.leaves(cand)[0].shape[0]
+        n_shards = self.mesh.shape[_POP_AXIS_NAME]
+        if n_cand % n_shards != 0:
+            # catches algorithms whose evaluated batch differs from pop_size
+            # (e.g. CSO's half-pop offspring) — the constructor check can't
+            raise ValueError(
+                f"eval_shard_map: the evaluated candidate batch ({n_cand}) "
+                f"is not divisible by the mesh's 'pop' axis ({n_shards} "
+                "shards); use the default GSPMD evaluation path for this "
+                "algorithm or resize the population/mesh"
+            )
+
+        def island(ps, c):
+            fit, new_ps = self.problem.evaluate(ps, c)
+            return all_gather(fit), new_ps
+
+        # check_vma=False: the gathered fitness and pass-through state ARE
+        # replicated after the tiled all_gather, but the static analyzer
+        # cannot prove it for arbitrary problem code
+        return jax.shard_map(
+            island,
+            mesh=self.mesh,
+            in_specs=(P(), P(_POP_AXIS_NAME)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )(pstate, cand)
 
     def _step_impl(self, state: StdWorkflowState) -> StdWorkflowState:
         mstates = list(state.monitors)
@@ -219,6 +293,9 @@ class StdWorkflow:
             astate = self.algorithm.init_tell(astate, fitness)
         else:
             astate = self.algorithm.tell(astate, fitness)
+        # apply per-field sharding annotations (field(sharding=...)) so the
+        # loop-carried algorithm state keeps its declared mesh layout
+        astate = constrain_state(astate, self.mesh)
         self._run_hooks("post_tell", mstates)
 
         new_state = state.replace(

@@ -1,0 +1,94 @@
+"""Multi-process SPMD test for init_distributed (VERDICT #8: the reference
+tests its Ray path with 2 fractional-CPU workers; the TPU-native analog is
+2 JAX processes over a DCN-emulating local coordinator, collectives on the
+CPU gloo backend)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    pid = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # load distributed.py directly: importing the evox_tpu package would
+    # build jnp constants and initialize the backend before jax.distributed
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "evox_tpu_distributed", sys.argv[4]
+    )
+    D = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(D)
+    D.init_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+        local_device_ids=[0],
+    )
+    assert D.process_count() == nprocs, D.process_count()
+    assert D.process_id() == pid
+    assert D.is_dist_initialized()
+    assert jax.device_count() == nprocs  # 1 local CPU device per process
+
+    # a real cross-process collective: global psum over the mesh
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = D.create_mesh(devices=jax.devices())
+    x = jnp.ones((4,)) * (pid + 1)
+    def island(x):
+        return D.all_gather(x, "pop")
+    y = jax.jit(
+        jax.shard_map(
+            island, mesh=mesh, in_specs=P("pop"), out_specs=P(), check_vma=False
+        )
+    )(jax.make_array_from_process_local_data(NamedSharding(mesh, P("pop")), x))
+    total = float(jnp.sum(y))
+    expected = sum(4 * (i + 1) for i in range(nprocs)) * 1.0
+    assert abs(total - expected) < 1e-6, (total, expected)
+    print(f"proc {pid} OK", flush=True)
+    """
+)
+
+
+def test_two_process_spmd(tmp_path):
+    import socket
+
+    nprocs = 2
+    with socket.socket() as s:  # grab a free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # workers use 1 device each, not the forced 8
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    dist_py = os.path.join(os.getcwd(), "evox_tpu", "core", "distributed.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(nprocs), port, dist_py],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for i in range(nprocs)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=100)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"proc {i} OK" in out
