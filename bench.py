@@ -128,14 +128,14 @@ def _flat_mlp(obs_dim: int, act_dim: int, hidden: int):
     return apply, dim
 
 
-def _rollout_problem():
+def _rollout_problem(**kwargs):
     from evox_tpu.problems.neuroevolution import PolicyRolloutProblem
     from evox_tpu.problems.neuroevolution.control import pendulum
 
     env = pendulum(max_steps=200)
     apply, dim = _flat_mlp(env.obs_dim, env.act_dim, RO_HIDDEN)
     prob = PolicyRolloutProblem(
-        apply, env, num_episodes=RO_EPISODES, stochastic_reset=False
+        apply, env, num_episodes=RO_EPISODES, stochastic_reset=False, **kwargs
     )
     return prob, dim
 
@@ -144,7 +144,10 @@ def bench_rollout_ours() -> float:
     from evox_tpu import StdWorkflow
     from evox_tpu.algorithms.so.es import OpenES
 
-    prob, dim = _rollout_problem()
+    # pendulum never terminates early -> the unrolled-scan rollout path
+    # (early_exit=False) removes per-iteration while_loop overhead; the
+    # reference has no such mode, its while_loop shape is the baseline
+    prob, dim = _rollout_problem(early_exit=False)
     algo = OpenES(jnp.zeros(dim), RO_POP, learning_rate=0.05, noise_stdev=0.05)
     wf = StdWorkflow(algo, prob, opt_direction="max")
     state = wf.init(jax.random.PRNGKey(0))

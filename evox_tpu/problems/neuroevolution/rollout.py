@@ -146,6 +146,14 @@ class PolicyRolloutProblem(Problem):
         obs_normalizer: an :class:`ObsNormalizer`; observations are
             normalized before the policy sees them and the running stats are
             updated from every (not-yet-done) step of every rollout.
+        early_exit: True (default) rolls out in a ``lax.while_loop`` that
+            stops as soon as every episode is done. Set False for envs that
+            never terminate early (e.g. pendulum): the rollout becomes a
+            ``lax.scan`` unrolled by ``unroll``, trading the per-iteration
+            loop overhead for straight-line code XLA can pipeline — a real
+            throughput win at large populations. Incompatible with
+            ``cap_episode`` (the cap is a traced bound).
+        unroll: scan unroll factor for the ``early_exit=False`` path.
     """
 
     def __init__(
@@ -158,6 +166,8 @@ class PolicyRolloutProblem(Problem):
         stochastic_reset: bool = True,
         cap_episode: Optional[CapEpisode] = None,
         obs_normalizer: Optional[ObsNormalizer] = None,
+        early_exit: bool = True,
+        unroll: int = 4,
     ):
         self.policy = policy
         self.env = env
@@ -167,6 +177,10 @@ class PolicyRolloutProblem(Problem):
         self.stochastic_reset = stochastic_reset
         self.cap_episode = cap_episode
         self.obs_normalizer = obs_normalizer
+        if not early_exit and cap_episode is not None:
+            raise ValueError("early_exit=False cannot be combined with cap_episode")
+        self.early_exit = early_exit
+        self.unroll = unroll
 
     def init(self, key=None) -> RolloutState:
         return RolloutState(
@@ -240,9 +254,19 @@ class PolicyRolloutProblem(Problem):
         done0 = jnp.zeros((pop_size, self.num_episodes), dtype=bool)
         total0 = jnp.zeros((pop_size, self.num_episodes))
         len0 = jnp.zeros((pop_size, self.num_episodes), dtype=jnp.int32)
-        _, _, _, total, ep_len, moments = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), env_state0, done0, total0, len0, moments0)
-        )
+        carry0 = (jnp.int32(0), env_state0, done0, total0, len0, moments0)
+        if self.early_exit:
+            _, _, _, total, ep_len, moments = jax.lax.while_loop(
+                cond, body, carry0
+            )
+        else:
+            # fixed trip count: straight-line scan XLA can software-pipeline
+            _, _, _, total, ep_len, moments = jax.lax.scan(
+                lambda c, _: (body(c), None),
+                carry0,
+                length=int(self.max_len),
+                unroll=self.unroll,
+            )[0]
         fitness = self.reduce_fn(total, axis=-1)
 
         cap = state.cap

@@ -257,3 +257,30 @@ def test_visualize_trajectory():
         frozen2 = jax.tree.map(lambda x: x[t_done + 2], traj.states)
         for a, b in zip(jax.tree.leaves(frozen), jax.tree.leaves(frozen2)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scan_rollout_matches_while_loop():
+    """early_exit=False (unrolled scan) must give identical fitness to the
+    default while_loop path on a non-terminating env."""
+    env = envs.pendulum(max_steps=50)
+    init_params, apply = mlp_policy((env.obs_dim, 8, env.act_dim))
+    adapter = TreeAndVector(init_params(jax.random.PRNGKey(0)))
+    pop = jax.vmap(adapter.to_tree)(
+        jax.random.normal(jax.random.PRNGKey(1), (8, adapter.dim))
+    )
+    kwargs = dict(num_episodes=2, stochastic_reset=False)
+    p_while = PolicyRolloutProblem(apply, env, **kwargs)
+    p_scan = PolicyRolloutProblem(apply, env, early_exit=False, unroll=4, **kwargs)
+    st = p_while.init(jax.random.PRNGKey(2))
+    f1, _ = jax.jit(p_while.evaluate)(st, pop)
+    f2, _ = jax.jit(p_scan.evaluate)(st, pop)
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f2), rtol=1e-6)
+
+
+def test_scan_rollout_rejects_cap_episode():
+    env = envs.pendulum()
+    _, apply = mlp_policy((env.obs_dim, 8, env.act_dim))
+    with pytest.raises(ValueError, match="early_exit"):
+        PolicyRolloutProblem(
+            apply, env, early_exit=False, cap_episode=CapEpisode()
+        )
