@@ -14,9 +14,11 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 from .de import select_rand_indices
 
 # [F, CR] parameter pool (Wang et al. 2011, §III)
@@ -24,10 +26,10 @@ _PARAM_POOL = jnp.asarray([[1.0, 0.1], [1.0, 0.9], [0.8, 0.2]], dtype=jnp.float3
 
 
 class CoDEState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    trials: jax.Array  # (3*pop, dim)
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    trials: jax.Array = field(sharding=P(POP_AXIS))  # (3*pop, dim)
+    key: jax.Array = field(sharding=P())
 
 
 class CoDE(Algorithm):

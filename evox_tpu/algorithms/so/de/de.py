@@ -13,9 +13,11 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 
 
 def select_rand_indices(key: jax.Array, pop_size: int, n: int) -> jax.Array:
@@ -31,10 +33,10 @@ def select_rand_indices(key: jax.Array, pop_size: int, n: int) -> jax.Array:
 
 
 class DEState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    trials: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    trials: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class DE(Algorithm):

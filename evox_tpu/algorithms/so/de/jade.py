@@ -13,23 +13,25 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 from .de import select_rand_indices
 
 
 class JaDEState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    trials: jax.Array
-    F: jax.Array  # per-individual, current generation
-    CR: jax.Array
-    mu_F: jax.Array
-    mu_CR: jax.Array
-    archive: jax.Array  # (pop, dim) replaced parents
-    archive_size: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    trials: jax.Array = field(sharding=P(POP_AXIS))
+    F: jax.Array = field(sharding=P(POP_AXIS))  # per-individual, current generation
+    CR: jax.Array = field(sharding=P(POP_AXIS))
+    mu_F: jax.Array = field(sharding=P())
+    mu_CR: jax.Array = field(sharding=P())
+    archive: jax.Array = field(sharding=P(POP_AXIS))  # (pop, dim) replaced parents
+    archive_size: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class JaDE(Algorithm):

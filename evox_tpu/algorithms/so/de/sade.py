@@ -14,26 +14,28 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 from .de import select_rand_indices
 
 _N_STRATEGY = 4
 
 
 class SaDEState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    trials: jax.Array
-    strategy: jax.Array  # (pop,) strategy chosen this generation
-    CR: jax.Array  # (pop,) crossover rate sampled this generation
-    probs: jax.Array  # (4,) strategy selection probabilities
-    success_mem: jax.Array  # (LP, 4) success counts ring buffer
-    failure_mem: jax.Array
-    CRm: jax.Array  # (4,) per-strategy CR memory
-    gen: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    trials: jax.Array = field(sharding=P(POP_AXIS))
+    strategy: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) strategy chosen this generation
+    CR: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) crossover rate sampled this generation
+    probs: jax.Array = field(sharding=P())  # (4,) strategy selection probabilities
+    success_mem: jax.Array = field(sharding=P())  # (LP, 4) success counts ring buffer
+    failure_mem: jax.Array = field(sharding=P())
+    CRm: jax.Array = field(sharding=P())  # (4,) per-strategy CR memory
+    gen: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class SaDE(Algorithm):

@@ -12,24 +12,26 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 from .de import select_rand_indices
 
 
 class SHADEState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    trials: jax.Array
-    F: jax.Array
-    CR: jax.Array
-    M_F: jax.Array  # (H,)
-    M_CR: jax.Array
-    mem_pos: jax.Array
-    archive: jax.Array
-    archive_size: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    trials: jax.Array = field(sharding=P(POP_AXIS))
+    F: jax.Array = field(sharding=P(POP_AXIS))
+    CR: jax.Array = field(sharding=P(POP_AXIS))
+    M_F: jax.Array = field(sharding=P())  # (H,)
+    M_CR: jax.Array = field(sharding=P())
+    mem_pos: jax.Array = field(sharding=P())
+    archive: jax.Array = field(sharding=P(POP_AXIS))
+    archive_size: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class SHADE(Algorithm):

@@ -1,6 +1,7 @@
 from .eval_monitor import EvalMonitor, EvalMonitorState
 from .pop_monitor import PopMonitor
 from .evoxvis_monitor import EvoXVisMonitor
+from .checkpoint_monitor import CheckpointMonitor
 from .profiler import StepTimerMonitor, trace as profiler_trace
 from . import profiler
 
@@ -9,6 +10,7 @@ __all__ = [
     "EvalMonitorState",
     "PopMonitor",
     "EvoXVisMonitor",
+    "CheckpointMonitor",
     "StepTimerMonitor",
     "profiler_trace",
     "profiler",

@@ -221,3 +221,46 @@ def test_vis_1d_animated():
     rng = np.random.default_rng(1)
     anim = plot_obj_space_1d([rng.random(8) for _ in range(4)], animated=True)
     assert hasattr(anim, "save")
+
+
+def test_checkpoint_monitor_autosaves(tmp_path):
+    from evox_tpu.monitors import CheckpointMonitor
+
+    mon = CheckpointMonitor(str(tmp_path), every=3, keep=2)
+    wf = _workflow(monitors=(mon,))
+    state = wf.init(jax.random.PRNGKey(9))
+    state = wf.run(state, 10)
+    jax.effects_barrier()
+    # gens 3, 6, 9 saved; keep=2 -> 6 and 9 remain
+    names = sorted(p.name for p in tmp_path.iterdir() if p.name.startswith("ckpt"))
+    assert names == ["ckpt_00000006", "ckpt_00000009"]
+    restored = mon.latest()
+    assert int(restored.generation) == 9
+    # restored state continues through the workflow
+    cont = wf.run(restored, 2)
+    assert int(cont.generation) == 11
+
+
+def test_checkpoint_monitor_adopts_existing_and_validates(tmp_path):
+    from evox_tpu.monitors import CheckpointMonitor
+
+    with pytest.raises(ValueError, match="every"):
+        CheckpointMonitor(str(tmp_path), every=0)
+    with pytest.raises(ValueError, match="keep"):
+        CheckpointMonitor(str(tmp_path), keep=0)
+
+    mon = CheckpointMonitor(str(tmp_path), every=2, keep=2)
+    wf = _workflow(monitors=(mon,))
+    state = wf.init(jax.random.PRNGKey(10))
+    state = wf.run(state, 5)
+    jax.effects_barrier()
+    # a NEW monitor over the same directory adopts the files on disk
+    mon2 = CheckpointMonitor(str(tmp_path), every=2, keep=2)
+    restored = mon2.latest()
+    assert restored is not None and int(restored.generation) == 4
+    # restore + rerun re-saves the same generations without duplicating
+    wf2 = _workflow(monitors=(mon2,))
+    state = wf2.run(restored.replace(first_step=False), 4)
+    jax.effects_barrier()
+    assert len(mon2.saved) == len(set(mon2.saved)) <= 2
+    assert all(p.exists() for p in mon2.saved)
