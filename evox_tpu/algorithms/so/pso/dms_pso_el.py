@@ -15,19 +15,21 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 
 
 class DMSPSOELState(PyTreeNode):
-    population: jax.Array
-    velocity: jax.Array
-    pbest: jax.Array
-    pbest_fitness: jax.Array
-    swarm_of: jax.Array  # (pop,) sub-swarm id per particle
-    gen: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    velocity: jax.Array = field(sharding=P(POP_AXIS))
+    pbest: jax.Array = field(sharding=P(POP_AXIS))
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    swarm_of: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) sub-swarm id per particle
+    gen: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class DMSPSOEL(Algorithm):

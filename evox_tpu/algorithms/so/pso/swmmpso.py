@@ -18,19 +18,21 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 from .topology import mutate_shortcuts, neighbour_best, ring_neighbours
 
 
 class SwmmPSOState(PyTreeNode):
-    population: jax.Array
-    velocity: jax.Array
-    pbest: jax.Array
-    pbest_fitness: jax.Array
-    adjacency: jax.Array  # bool (pop, pop); all-False when using static circles
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    velocity: jax.Array = field(sharding=P(POP_AXIS))
+    pbest: jax.Array = field(sharding=P(POP_AXIS))
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    adjacency: jax.Array = field(sharding=P())  # bool (pop, pop); all-False when using static circles
+    key: jax.Array = field(sharding=P())
 
 
 class SwmmPSO(Algorithm):
