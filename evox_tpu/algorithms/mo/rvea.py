@@ -12,67 +12,11 @@ import jax.numpy as jnp
 
 from ...core.struct import PyTreeNode
 from ...operators.sampling.uniform import UniformSampling
-from ...utils.common import cos_dist
+from ...operators.selection.rvea_selection import (
+    ref_vec_guided,
+    ref_vec_guided_indices,
+)
 from .common import GAMOAlgorithm, MOState, uniform_init
-
-
-def ref_vec_guided_indices(
-    fitness: jax.Array,
-    vectors: jax.Array,
-    theta: jax.Array,
-) -> Tuple[jax.Array, jax.Array]:
-    """APD selection winners: per reference vector, the index of the
-    minimal-APD individual assigned to it. Returns ``(winner, has)`` where
-    ``winner`` is (n_vectors,) indices (0 where empty) and ``has`` marks
-    non-empty niches."""
-    n, m = fitness.shape
-    nv = vectors.shape[0]
-    translated = fitness - jnp.min(fitness, axis=0)
-    # angle to each reference vector
-    cos = jnp.clip(cos_dist(translated, vectors), -1.0, 1.0)  # (n, nv)
-    assigned = jnp.argmax(cos, axis=1)  # (n,)
-
-    # per-vector minimum angle between vectors (gamma normalizer)
-    vcos = jnp.clip(cos_dist(vectors, vectors), -1.0, 1.0)
-    vcos = vcos - 2.0 * jnp.eye(nv)
-    gamma = jnp.arccos(jnp.clip(jnp.max(vcos, axis=1), -1.0, 1.0))
-    gamma = jnp.maximum(gamma, 1e-6)
-
-    angle = jnp.arccos(jnp.clip(cos[jnp.arange(n), assigned], -1.0, 1.0))
-    norm = jnp.linalg.norm(translated, axis=1)
-    apd = (1.0 + m * theta * angle / gamma[assigned]) * norm
-
-    # segment-argmin over assigned vectors
-    INF = jnp.inf
-    val = jnp.where(norm > 0, apd, INF)  # guard all-zero rows
-    best_val = jnp.full((nv,), INF).at[assigned].min(val)
-    is_best = val == best_val[assigned]
-    winner = (
-        jnp.full((nv,), n, dtype=jnp.int32)
-        .at[assigned]
-        .min(jnp.where(is_best, jnp.arange(n), n).astype(jnp.int32))
-    )
-    has = winner < n
-    return jnp.where(has, winner, 0), has
-
-
-def ref_vec_guided(
-    pop: jax.Array,
-    fitness: jax.Array,
-    vectors: jax.Array,
-    theta: jax.Array,
-) -> Tuple[jax.Array, jax.Array]:
-    """APD selection: pick at most one individual per reference vector.
-
-    Returns (pop_out, fit_out) with exactly ``len(vectors)`` rows; empty
-    niches are filled with inf-fitness placeholder rows (reference
-    rvea_selection.py:8-54 keeps nan rows; inf keeps downstream math total).
-    """
-    nv, m = vectors.shape[0], fitness.shape[1]
-    winner, has = ref_vec_guided_indices(fitness, vectors, theta)
-    pop_out = jnp.where(has[:, None], pop[winner], jnp.zeros_like(pop[winner]))
-    fit_out = jnp.where(has[:, None], fitness[winner], jnp.full((nv, m), jnp.inf))
-    return pop_out, fit_out
 
 
 class RVEAState(PyTreeNode):

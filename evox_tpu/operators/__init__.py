@@ -1,3 +1,3 @@
-from . import selection, crossover, mutation, sampling
+from . import selection, crossover, mutation, sampling, gaussian_process
 
-__all__ = ["selection", "crossover", "mutation", "sampling"]
+__all__ = ["selection", "crossover", "mutation", "sampling", "gaussian_process"]

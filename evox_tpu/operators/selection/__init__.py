@@ -6,6 +6,7 @@ from .non_dominate import (
     non_dominate_indices,
     NonDominate,
 )
+from .rvea_selection import ref_vec_guided, ref_vec_guided_indices
 from .basic import (
     tournament,
     tournament_multifit,
@@ -28,4 +29,6 @@ __all__ = [
     "topk_fit",
     "uniform_rand",
     "select_rand_pbest",
+    "ref_vec_guided",
+    "ref_vec_guided_indices",
 ]
