@@ -1,8 +1,20 @@
 """EAG-MOEA/D (Cai, Li & Fan 2014): external-archive guided MOEA/D.
-Capability parity with reference src/evox/algorithms/mo/eagmoead.py:43+.
-A crowding-maintained external archive guides mating; subproblem selection
-probabilities follow each subproblem's archive-admission success rate over a
-learning period."""
+Capability parity with reference src/evox/algorithms/mo/eagmoead.py:43-212,
+full mechanics:
+
+- success-guided subproblem sampling: probability of working on subproblem
+  i follows its archive-admission share over the last ``learning_period``
+  generations, with the paper's 0.002 exploration floor (ref ask:119-123);
+- both parents come from the sampled subproblem's weight neighborhood
+  (ref ask:127-137) — the archive guides *where* to search, not *with what*;
+- inner population: sequential MOEA/D neighborhood replacement with
+  weighted-sum aggregation over each offspring's subproblem neighborhood
+  (ref tell:160-180);
+- external archive: NSGA-II environmental selection over archive +
+  offspring; admitted offspring credit their ORIGIN subproblem's success
+  histogram (ref tell:182-203 — without replicating its s-column
+  ``gen % LGs + 1`` out-of-range quirk).
+"""
 
 from __future__ import annotations
 
@@ -15,17 +27,17 @@ from ...core.struct import PyTreeNode
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
 from ...operators.selection.non_dominate import non_dominate_indices
-from .moead import MOEAD, MOEADState
+from .moead import MOEAD
 
 
 class EAGMOEADState(PyTreeNode):
-    population: jax.Array
+    population: jax.Array  # external archive (the algorithm's output)
     fitness: jax.Array
-    ideal: jax.Array
-    archive: jax.Array
-    archive_fitness: jax.Array
+    inner_pop: jax.Array  # MOEA/D working population
+    inner_fit: jax.Array
     success: jax.Array  # (LP, n) archive admissions per subproblem
     offspring: jax.Array
+    offspring_loc: jax.Array  # (n,) subproblem each offspring came from
     gen: jax.Array
     key: jax.Array
 
@@ -33,76 +45,102 @@ class EAGMOEADState(PyTreeNode):
 class EAGMOEAD(MOEAD):
     def __init__(self, *args, learning_period: int = 8, **kwargs):
         kwargs.setdefault("aggregate_op", "weighted_sum")
+        if kwargs["aggregate_op"] != "weighted_sum":
+            # tell() does not track an ideal point, which every other
+            # scalarization needs — reject rather than silently mis-aggregate
+            raise ValueError(
+                "EAGMOEAD supports only aggregate_op='weighted_sum' "
+                "(the paper's formulation)"
+            )
         super().__init__(*args, **kwargs)
         self.LP = learning_period
 
     def init(self, key: jax.Array) -> EAGMOEADState:
         base = super().init(key)
+        n = self.pop_size
         return EAGMOEADState(
             population=base.population,
-            fitness=base.fitness,
-            ideal=base.ideal,
-            archive=base.population,
-            archive_fitness=jnp.full((self.pop_size, self.n_objs), jnp.inf),
-            success=jnp.ones((self.LP, self.pop_size)),
-            offspring=base.offspring,
+            fitness=jnp.full((n, self.n_objs), jnp.inf),
+            inner_pop=base.population,
+            inner_fit=jnp.full((n, self.n_objs), jnp.inf),
+            success=jnp.zeros((self.LP, n)),
+            offspring=base.population,
+            offspring_loc=jnp.zeros((n,), dtype=jnp.int32),
             gen=jnp.zeros((), jnp.int32),
             key=base.key,
         )
 
-    def init_tell(self, state, fitness):
-        return state.replace(
-            fitness=fitness,
-            archive_fitness=fitness,
-            ideal=jnp.min(fitness, axis=0),
-        )
+    def init_tell(self, state: EAGMOEADState, fitness: jax.Array) -> EAGMOEADState:
+        return state.replace(fitness=fitness, inner_fit=fitness)
 
-    def ask(self, state) -> Tuple[jax.Array, EAGMOEADState]:
+    def ask(self, state: EAGMOEADState) -> Tuple[jax.Array, EAGMOEADState]:
         key, k_sel, k_pick, k_x, k_m = jax.random.split(state.key, 5)
         n = self.pop_size
-        # subproblem sampling by success probability
-        rate = jnp.sum(state.success, axis=0)
-        probs = rate / jnp.sum(rate)
+        # subproblem sampling by archive-admission success, floored so cold
+        # subproblems keep being explored (ref: d = s/sum(s) + 0.002)
+        s = jnp.sum(state.success, axis=0) + 1e-6
+        d = s / jnp.sum(s) + 0.002
+        probs = d / jnp.sum(d)
         sub = jax.random.choice(k_sel, n, (n,), p=probs)
-        # parents: one from the neighborhood, one from the archive
-        k_pick1, k_pick2 = jax.random.split(k_pick)
-        picks = jax.random.randint(k_pick1, (n,), 0, self.T)
-        p1 = self.neighbors[sub, picks]
-        p2 = jax.random.randint(k_pick2, (n,), 0, n)
+        # both parents from the sampled subproblem's neighborhood
+        k_p1, k_p2 = jax.random.split(k_pick)
+        i1 = jax.random.randint(k_p1, (n,), 0, self.T)
+        i2 = jax.random.randint(k_p2, (n,), 0, self.T)
+        p1 = self.neighbors[sub, i1]
+        p2 = self.neighbors[sub, i2]
         parents = jnp.stack(
-            [state.population[p1], state.archive[p2]], axis=1
+            [state.inner_pop[p1], state.inner_pop[p2]], axis=1
         ).reshape(2 * n, self.dim)
         off = simulated_binary(k_x, parents)[0::2]
         off = polynomial(k_m, off, (self.lb, self.ub))
-        return off, state.replace(offspring=off, key=key)
+        return off, state.replace(offspring=off, offspring_loc=sub, key=key)
 
-    def tell(self, state, fitness):
-        base = super().tell(
-            MOEADState(
-                population=state.population,
-                fitness=state.fitness,
-                ideal=state.ideal,
-                offspring=state.offspring,
-                key=state.key,
-            ),
-            fitness,
+    def tell(self, state: EAGMOEADState, fitness: jax.Array) -> EAGMOEADState:
+        n = self.pop_size
+        nbr = self.neighbors  # (n, T)
+        w = self.weights
+        zeros = jnp.zeros((self.n_objs,))  # weighted_sum ignores ideal
+
+        # sequential neighborhood replacement (order-dependent, as in the
+        # reference's fori_loop tell:160-180): offspring i may replace any
+        # incumbent in its ORIGIN subproblem's neighborhood it improves
+        def body(i, carry):
+            pop, fit = carry
+            loc = state.offspring_loc[i]
+            idx = nbr[loc]  # (T,)
+            g_old = self.agg(fit[idx], w[idx], zeros)  # (T,)
+            g_new = self.agg(
+                jnp.broadcast_to(fitness[i], (self.T, self.n_objs)), w[idx], zeros
+            )
+            replace = g_new < g_old
+            pop = pop.at[idx].set(
+                jnp.where(replace[:, None], state.offspring[i], pop[idx])
+            )
+            fit = fit.at[idx].set(
+                jnp.where(replace[:, None], fitness[i], fit[idx])
+            )
+            return pop, fit
+
+        inner_pop, inner_fit = jax.lax.fori_loop(
+            0, n, body, (state.inner_pop, state.inner_fit)
         )
-        # archive update: non-dominance + crowding over archive ∪ offspring
-        merged_pop = jnp.concatenate([state.archive, state.offspring], axis=0)
-        merged_fit = jnp.concatenate([state.archive_fitness, fitness], axis=0)
-        keep = non_dominate_indices(merged_fit, self.pop_size)
-        admitted = keep >= self.pop_size  # offspring rows admitted
-        # credit the admitting subproblem (offspring i came from subproblem i)
-        off_idx = jnp.where(admitted, keep - self.pop_size, self.pop_size)
-        succ = jnp.zeros((self.pop_size,)).at[off_idx].add(1.0, mode="drop")
-        success = state.success.at[state.gen % self.LP].set(succ)
+
+        # external archive: environmental selection over archive + offspring
+        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        keep = non_dominate_indices(merged_fit, n)
+        admitted = keep >= n  # which kept rows are offspring
+        # credit each admitted offspring's origin subproblem
+        adm_loc = jnp.where(
+            admitted, state.offspring_loc[jnp.clip(keep - n, 0, n - 1)], n
+        )
+        hist = jnp.zeros((n,)).at[adm_loc].add(1.0, mode="drop")
+        success = state.success.at[state.gen % self.LP].set(hist)
         return state.replace(
-            population=base.population,
-            fitness=base.fitness,
-            ideal=base.ideal,
-            archive=merged_pop[keep],
-            archive_fitness=merged_fit[keep],
+            population=merged_pop[keep],
+            fitness=merged_fit[keep],
+            inner_pop=inner_pop,
+            inner_fit=inner_fit,
             success=success,
             gen=state.gen + 1,
-            key=base.key,
         )

@@ -20,7 +20,11 @@ class TDEA(GAMOAlgorithm):
         super().__init__(lb, ub, n_objs, pop_size)
         refs, n = UniformSampling(pop_size, n_objs)()
         self.refs = refs / jnp.linalg.norm(refs, axis=1, keepdims=True)
-        self.theta = theta
+        # boundary weight vectors (single nonzero component) use a huge
+        # theta so their clusters select almost purely by perpendicular
+        # distance, preserving objective-extreme points (ref tdea.py:38-39)
+        boundary = jnp.sum(refs > 1e-4, axis=1) == 1
+        self.theta_vec = jnp.where(boundary, 1e6, theta)
         self.pop_size = n
 
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
@@ -30,7 +34,7 @@ class TDEA(GAMOAlgorithm):
         cluster = jnp.argmax(cos, axis=1)
         d1 = norm[:, 0] * jnp.max(cos, axis=1)
         d2 = norm[:, 0] * jnp.sqrt(jnp.maximum(1.0 - jnp.max(cos, axis=1) ** 2, 0.0))
-        pbi = d1 + self.theta * d2
+        pbi = d1 + self.theta_vec[cluster] * d2
         # theta-rank: position of each individual inside its cluster by pbi
         n = fit.shape[0]
         order = jnp.lexsort((pbi, cluster))  # cluster-major, pbi asc

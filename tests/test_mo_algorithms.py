@@ -145,3 +145,43 @@ def test_knea_adaptive_radius_updates():
     state = wf.run(state, 5)
     assert float(state.algo.r) != 1.0
     assert bool(jnp.any(state.algo.knee))
+
+
+def test_bceibea_dtlz2_igd():
+    assert _igd_after(build(BCEIBEA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.2
+
+
+def test_eagmoead_zdt1_igd():
+    zdt_dim = 12
+    algo = EAGMOEAD(jnp.zeros(zdt_dim), jnp.ones(zdt_dim), n_objs=2, pop_size=100)
+    assert _igd_after(algo, ZDT1(n_dim=zdt_dim), 150) < 0.05
+
+
+def test_eagmoead_dtlz2_igd():
+    # weighted-sum aggregation caps concave-front coverage (same as ref)
+    assert _igd_after(build(EAGMOEAD, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_gde3_dtlz2_igd():
+    assert _igd_after(build(GDE3, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.2
+
+
+def test_immoea_dtlz2_igd():
+    assert _igd_after(build(IMMOEA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.25
+
+
+def test_moeaddra_dtlz2_igd():
+    assert _igd_after(build(MOEADDRA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.2
+
+
+def test_moeadm2m_dtlz2_igd():
+    assert _igd_after(build(MOEADM2M, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_rveaa_dtlz2_igd():
+    algo = RVEAa(LB, UB, n_objs=M, pop_size=100, max_gen=100)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.15
+
+
+def test_tdea_dtlz2_igd():
+    assert _igd_after(build(TDEA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.15
