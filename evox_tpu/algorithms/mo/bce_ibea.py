@@ -1,11 +1,23 @@
 """BCE-IBEA (Li, Yang & Liu 2016): Bi-Criterion Evolution framework with
 IBEA as the non-Pareto-criterion (NPC) evolution. Capability parity with
-reference src/evox/algorithms/mo/bce_ibea.py:174+.
+reference src/evox/algorithms/mo/bce_ibea.py:20-332, full mechanics:
 
-Two co-evolving sets: the PC archive (Pareto criterion: non-dominance +
-density) and the NPC population (IBEA's epsilon-indicator fitness). Each
-generation both contribute offspring; PC keeps exploration on parts of the
-front the indicator collapses."""
+- alternating generations (counter parity, ref ask/tell:241-332): odd =
+  Pareto-criterion exploration round, even = NPC (IBEA) round;
+- exploration operator (ref exploration:41-80): only PC members with at
+  most one NPC neighbor inside the adaptive niche radius
+  r = (n_nd / n) * r0 spawn offspring, mated with random partners;
+- PC selection (ref pc_selection:84-146): when the non-dominated set
+  exceeds the budget, iteratively remove the most crowded member by the
+  product-of-scaled-distances niche count; otherwise keep only
+  non-dominated members (padded with the first);
+- NPC environmental selection reuses IBEA's iterative worst-removal.
+
+One deliberate deviation: the reference's even-phase PC selection pairs the
+PC population with the NPC objective array (bce_ibea.py:313-317), which
+mismatches solutions and objectives; the PC population's own objectives are
+used here.
+"""
 
 from __future__ import annotations
 
@@ -15,18 +27,84 @@ import jax
 import jax.numpy as jnp
 
 from ...core.struct import PyTreeNode
-from ...operators.selection.non_dominate import non_dominate
-from .common import GAMOAlgorithm, uniform_init
-from .ibea import IBEA, ibea_fitness
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
+from ...operators.selection.basic import tournament
+from ...operators.selection.non_dominate import non_dominated_sort
+from ...utils.common import pairwise_euclidean_dist
+from .common import uniform_init
+from .ibea import IBEA, ibea_fitness
+
+
+def exploration(pc_fit: jax.Array, npc_fit: jax.Array, n_nd, n: int) -> jax.Array:
+    """Boolean mask of PC members in regions the NPC population has not
+    reached (<= 1 NPC neighbor within the adaptive radius)."""
+    f_min = jnp.min(pc_fit, axis=0)
+    f_max = jnp.max(pc_fit, axis=0)
+    span = jnp.maximum(f_max - f_min, 1e-12)
+    pc_n = (pc_fit - f_min) / span
+    npc_n = (npc_fit - f_min) / span
+    d_pc = pairwise_euclidean_dist(pc_n, pc_n)
+    d_pc = jnp.where(jnp.eye(d_pc.shape[0], dtype=bool), jnp.inf, d_pc)
+    d_pc = jnp.where(jnp.isnan(d_pc), jnp.inf, d_pc)
+    sd = jnp.sort(d_pc, axis=1)
+    r0 = jnp.mean(sd[:, min(2, sd.shape[1] - 1)])
+    r = n_nd / n * r0
+    d_cross = pairwise_euclidean_dist(pc_n, npc_n)
+    return jnp.sum(d_cross <= r, axis=1) <= 1
+
+
+def pc_selection(
+    pc: jax.Array, pc_fit: jax.Array, n: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pareto-criterion selection: non-dominated members, niche-thinned to
+    ``n`` by iterative removal of the most crowded."""
+    rank = non_dominated_sort(pc_fit, until=1)  # only the first front matters
+    mask = rank == 0
+    n_nd = jnp.sum(mask)
+
+    def thin(mask):
+        f_max = jnp.max(jnp.where(mask[:, None], pc_fit, -jnp.inf), axis=0)
+        f_min = jnp.min(jnp.where(mask[:, None], pc_fit, jnp.inf), axis=0)
+        norm = (pc_fit - f_min) / jnp.maximum(f_max - f_min, 1e-12)
+        norm = jnp.where(mask[:, None], norm, jnp.inf)
+        dist = pairwise_euclidean_dist(norm, norm)
+        dist = jnp.where(jnp.eye(dist.shape[0], dtype=bool), jnp.inf, dist)
+        dist = jnp.where(jnp.isnan(dist), jnp.inf, dist)
+        sd = jnp.sort(dist, axis=1)
+        sd = jnp.where(mask[:, None], sd, 0.0)
+        r = jnp.sum(sd[:, min(2, sd.shape[1] - 1)]) / n_nd
+        big_r = jnp.minimum(dist / r, 1.0)
+
+        def loop(carry):
+            i, mask, big_r = carry
+            crowd = 1.0 - jnp.prod(big_r, axis=0)
+            idx = jnp.argmax(jnp.where(mask, crowd, -jnp.inf))
+            mask = mask.at[idx].set(False)
+            big_r = big_r.at[idx, :].set(1.0).at[:, idx].set(1.0)
+            return i - 1, mask, big_r
+
+        _, mask, _ = jax.lax.while_loop(
+            lambda c: c[0] > n, loop, (n_nd, mask, big_r)
+        )
+        return mask
+
+    mask = jax.lax.cond(n_nd > n, thin, lambda m: m, mask)
+    # gather kept indices, padding with the first kept member
+    idx = jnp.where(mask, size=mask.shape[0], fill_value=-1)[0]
+    idx = jnp.where(idx == -1, idx[0], idx)[:n]
+    return pc[idx], pc_fit[idx], n_nd
 
 
 class BCEIBEAState(PyTreeNode):
-    population: jax.Array  # NPC (IBEA) population
+    population: jax.Array  # PC archive (the algorithm's output)
     fitness: jax.Array
-    archive: jax.Array  # PC archive
-    archive_fitness: jax.Array
+    npc: jax.Array  # NPC (IBEA) population
+    npc_fit: jax.Array
+    new_pc: jax.Array  # PC-exploration offspring awaiting the even phase
+    new_pc_fit: jax.Array
+    n_nd: jax.Array
+    counter: jax.Array
     offspring: jax.Array
     key: jax.Array
 
@@ -39,50 +117,89 @@ class BCEIBEA(IBEA):
         return BCEIBEAState(
             population=pop,
             fitness=inf,
-            archive=pop,
-            archive_fitness=inf,
+            npc=pop,
+            npc_fit=inf,
+            new_pc=pop,
+            new_pc_fit=inf,
+            n_nd=jnp.asarray(0, jnp.int32),
+            counter=jnp.asarray(1, jnp.int32),
             offspring=pop,
             key=key,
         )
 
-    def init_ask(self, state) -> Tuple[jax.Array, BCEIBEAState]:
+    def init_ask(self, state: BCEIBEAState) -> Tuple[jax.Array, BCEIBEAState]:
         return state.population, state
 
-    def init_tell(self, state, fitness):
-        return state.replace(fitness=fitness, archive_fitness=fitness)
+    def init_tell(self, state: BCEIBEAState, fitness: jax.Array) -> BCEIBEAState:
+        pc, pc_fit, n_nd = pc_selection(state.population, fitness, self.pop_size)
+        return state.replace(
+            population=pc,
+            fitness=pc_fit,
+            npc_fit=fitness,
+            new_pc_fit=fitness,
+            n_nd=n_nd.astype(jnp.int32),
+        )
 
-    def ask(self, state) -> Tuple[jax.Array, BCEIBEAState]:
-        key, k_npc, k_pc, k_x, k_m = jax.random.split(state.key, 5)
-        half = self.pop_size // 2
-        # NPC parents by indicator tournament, PC parents by random archive
-        score = ibea_fitness(state.fitness, self.kappa)
-        cand = jax.random.randint(k_npc, (self.pop_size, 2), 0, self.pop_size)
-        win = jnp.where(
-            score[cand[:, 0]] > score[cand[:, 1]], cand[:, 0], cand[:, 1]
+    def ask(self, state: BCEIBEAState) -> Tuple[jax.Array, BCEIBEAState]:
+        return jax.lax.cond(
+            state.counter % 2 == 0, self._ask_even, self._ask_odd, state
         )
-        npc_parents = state.population[win]
-        pc_parents = state.archive[
-            jax.random.randint(k_pc, (self.pop_size,), 0, self.pop_size)
-        ]
-        parents = jnp.concatenate(
-            [npc_parents[:half], pc_parents[: self.pop_size - half]], axis=0
-        )
+
+    def _ask_odd(self, state):
+        """PC exploration round: sparse-region PC members mate with random
+        partners; non-explored slots re-propose the PC member itself."""
+        key, k_mate, k_x, k_m = jax.random.split(state.key, 4)
+        n = self.pop_size
+        s = exploration(state.fitness, state.npc_fit, state.n_nd, n)
+        partner = jax.random.randint(k_mate, (n,), 0, n)
+        pairs = jnp.stack(
+            [state.population, state.population[partner]], axis=1
+        ).reshape(2 * n, self.dim)
+        child = simulated_binary(k_x, pairs)[0::2]
+        child = polynomial(k_m, child, (self.lb, self.ub))
+        off = jnp.where(s[:, None], child, state.population)
+        return off, state.replace(offspring=off, key=key)
+
+    def _ask_even(self, state):
+        """NPC (IBEA) round: indicator-fitness tournament + variation."""
+        key, k_sel, k_x, k_m = jax.random.split(state.key, 4)
+        score = ibea_fitness(state.npc_fit, self.kappa)
+        parents = tournament(k_sel, state.npc, -score)
         off = simulated_binary(k_x, parents)
         off = polynomial(k_m, off, (self.lb, self.ub))
         return off, state.replace(offspring=off, key=key)
 
-    def tell(self, state, fitness):
-        # NPC (IBEA) environmental selection
-        merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
-        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
-        npc_pop, npc_fit = self.select(state, merged_pop, merged_fit)
-        # PC archive: non-dominance + crowding over archive ∪ offspring
-        pc_merged_pop = jnp.concatenate([state.archive, state.offspring], axis=0)
-        pc_merged_fit = jnp.concatenate([state.archive_fitness, fitness], axis=0)
-        pc_pop, pc_fit = non_dominate(pc_merged_pop, pc_merged_fit, self.pop_size)
+    def tell(self, state: BCEIBEAState, fitness: jax.Array) -> BCEIBEAState:
+        # both phases feed the NPC population identically — compute once
+        # outside the cond so the IBEA removal loop is traced only once
+        npc, npc_fit = self._npc_select(
+            jnp.concatenate([state.npc, state.offspring], axis=0),
+            jnp.concatenate([state.npc_fit, fitness], axis=0),
+        )
+        state = jax.lax.cond(
+            state.counter % 2 == 0, self._tell_even, self._tell_odd, state, fitness
+        )
         return state.replace(
-            population=npc_pop,
-            fitness=npc_fit,
-            archive=pc_pop,
-            archive_fitness=pc_fit,
+            npc=npc, npc_fit=npc_fit, counter=state.counter + 1
+        )
+
+    def _npc_select(self, pop, fit):
+        """IBEA iterative worst-removal over a merged set (inherited math)."""
+        return IBEA.select(self, None, pop, fit)
+
+    def _tell_odd(self, state, fitness):
+        return state.replace(new_pc=state.offspring, new_pc_fit=fitness)
+
+    def _tell_even(self, state, fitness):
+        merged_pop = jnp.concatenate(
+            [state.population, state.offspring, state.new_pc], axis=0
+        )
+        merged_fit = jnp.concatenate(
+            [state.fitness, fitness, state.new_pc_fit], axis=0
+        )
+        pc, pc_fit, n_nd = pc_selection(merged_pop, merged_fit, self.pop_size)
+        return state.replace(
+            population=pc,
+            fitness=pc_fit,
+            n_nd=n_nd.astype(jnp.int32),
         )

@@ -28,9 +28,11 @@ def _sde_density(fit: jax.Array) -> jax.Array:
 
 
 class SRA(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, pc: float = 0.5, sweeps: int = None):
+    def __init__(self, lb, ub, n_objs, pop_size, pc: float = None, sweeps: int = None):
         super().__init__(lb, ub, n_objs, pop_size)
-        self.pc = pc  # probability of comparing by indicator-1
+        # probability of comparing by indicator-1; None = the paper's
+        # per-generation draw from U(0.4, 0.6) (reference sra.py:184)
+        self.pc = pc
         self.sweeps = sweeps or pop_size
 
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
@@ -41,14 +43,18 @@ class SRA(GAMOAlgorithm):
         sde = -_sde_density(fit)  # lower = better (sparser preferred)
 
         key = jax.random.fold_in(state.key, 7)
-        perm = jax.random.permutation(key, n)
+        key, k_pc, k_perm = jax.random.split(key, 3)
+        pc = (
+            jax.random.uniform(k_pc) * 0.2 + 0.4 if self.pc is None else self.pc
+        )
+        perm = jax.random.permutation(k_perm, n)
 
         idx = jnp.arange(n)
 
         def sweep(s, carry):
             order, key = carry
             key, k_choice = jax.random.split(key)
-            use_eps = jax.random.uniform(k_choice, (n,)) < self.pc
+            use_eps = jax.random.uniform(k_choice, (n,)) < pc
             # odd-even transposition pass with traced parity: each element
             # computes its pair partner; boundary elements pair with self
             offset = s % 2
