@@ -5,7 +5,10 @@ pure-JAX :class:`~evox_tpu.operators.gaussian_process.GPRegression`).
 
 Per reference-vector cluster, univariate GPs learn the inverse mapping
 objective -> decision variable; sampling the models (with predictive noise)
-generates offspring directly on the approximated front."""
+generates offspring directly on the approximated front. Models are
+univariate (one GP per decision variable, the reference's random-grouping
+with group size 1) — finer-grained than the reference's multivariate
+groups, same mechanism."""
 
 from __future__ import annotations
 
@@ -39,7 +42,6 @@ class IMMOEA(Algorithm):
         n_objs: int,
         pop_size: int,
         k_clusters: int = 5,
-        model_group_size: int = 3,
         gp_fit_steps: int = 10,
     ):
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
@@ -52,7 +54,6 @@ class IMMOEA(Algorithm):
         self.S = max(2, pop_size // self.K)
         self.pop_size = self.K * self.S
         self.gp = GPRegression(fit_steps=gp_fit_steps)
-        self.Lg = model_group_size
 
     def init(self, key: jax.Array) -> IMMOEAState:
         key, k = jax.random.split(key)
