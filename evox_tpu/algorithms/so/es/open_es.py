@@ -12,16 +12,21 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from jax.sharding import PartitionSpec as P
+
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 from .common import make_optimizer
 
 
 class OpenESState(PyTreeNode):
-    center: jax.Array
-    opt_state: tuple
-    noise: jax.Array
-    key: jax.Array
+    # center/optimizer replicate; the (pop, dim) noise batch — the big
+    # array at north-star populations — shards over the pop axis
+    center: jax.Array = field(sharding=P())
+    opt_state: tuple = field(sharding=P())
+    noise: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class OpenES(Algorithm):
