@@ -21,7 +21,10 @@ from jax.experimental import io_callback
 from ..core.monitor import Monitor
 from .common import host0_sharding
 from ..core.struct import PyTreeNode
-from ..operators.selection.non_dominate import non_dominate
+from ..operators.selection.non_dominate import (
+    crowding_distance,
+    non_dominated_sort,
+)
 
 
 class EvalMonitorState(PyTreeNode):
@@ -119,12 +122,30 @@ class EvalMonitor(Monitor):
             prev_sol = mstate.topk_solution
         merged_fit = jnp.concatenate([prev_fit, key_fit])
         merged_sol = jax.tree.map(lambda a, b: jnp.concatenate([a, b]), prev_sol, cand)
-        # fixed-capacity archive refresh: one environmental selection
-        new_sol, new_fit = non_dominate(merged_sol, merged_fit, self.pf_capacity)
+        # fixed-capacity archive refresh: rank once on the merged set, keep
+        # the best (rank, -crowding) rows, then inf-pad everything that is
+        # not a FINITE rank-0 member — environmental selection tops up with
+        # dominated rows whenever the true front is smaller than the
+        # capacity, and those must not masquerade as front members. One
+        # liveness criterion (finite & rank 0) drives the padding, the
+        # count, and get_pf_mask alike.
+        rank = non_dominated_sort(merged_fit, until=self.pf_capacity)
+        worst = jnp.sort(rank)[self.pf_capacity - 1]
+        crowd = crowding_distance(merged_fit, mask=rank == worst)
+        order = jnp.lexsort((-crowd, rank))[: self.pf_capacity]
+        sel_fit = merged_fit[order]
+        live = (rank[order] == 0) & jnp.all(jnp.isfinite(sel_fit), axis=-1)
+        # stable re-sort so live rows occupy the leading slots (a finite
+        # rank-0 block can be interrupted by an inf-coordinate row)
+        reorder = jnp.argsort(~live, stable=True)
+        sel_fit = jnp.where(live[reorder][:, None], sel_fit[reorder], jnp.inf)
+        new_sol = jax.tree.map(
+            lambda x: x[order][reorder], merged_sol
+        )
         return EvalMonitorState(
-            topk_fitness=new_fit * self.opt_direction,  # store user direction
+            topk_fitness=sel_fit * self.opt_direction,  # store user direction
             topk_solution=new_sol,
-            pf_count=jnp.sum(jnp.all(jnp.isfinite(new_fit), axis=-1).astype(jnp.int32)),
+            pf_count=jnp.sum(live.astype(jnp.int32)),
         )
 
     # --------------------------------------------------------------- getters

@@ -197,3 +197,54 @@ def test_shard_map_rejects_half_pop_algorithms():
     state = wf.step(state)  # init generation: full pop, divisible
     with _pytest.raises(ValueError, match="candidate batch"):
         wf.step(state)
+
+
+def test_eval_monitor_mo_archive_workflow_level():
+    """VERDICT weak #6: the MO Pareto-archive path exercised through the
+    full workflow (run() fusion), with jit-safe padded getters."""
+    from evox_tpu.algorithms.mo import NSGA2
+    from evox_tpu.problems.numerical import ZDT1
+    from evox_tpu.metrics import igd
+
+    prob = ZDT1(n_dim=8)
+    algo = NSGA2(jnp.zeros(8), jnp.ones(8), n_objs=2, pop_size=32)
+    mon = EvalMonitor(multi_obj=True, pf_capacity=64)
+    wf = StdWorkflow(algo, prob, monitors=[mon])
+    state = wf.init(jax.random.PRNGKey(17))
+    state = wf.run(state, 100)
+    mstate = state.monitors[0]
+    pf = mon.get_pf_fitness(mstate)  # eager: sliced to live rows
+    assert pf.ndim == 2 and pf.shape[1] == 2 and pf.shape[0] > 0
+    assert bool(jnp.isfinite(pf).all())
+    # archive is mutually non-dominated
+    from evox_tpu.operators.selection.non_dominate import non_dominated_sort
+
+    assert int(non_dominated_sort(pf).max()) == 0
+    # jit-side: padded buffer + mask agree with the eager slice
+    @jax.jit
+    def padded(ms):
+        return mon.get_pf_fitness(ms), mon.get_pf_mask(ms)
+
+    buf, mask = padded(mstate)
+    assert buf.shape == (64, 2)
+    assert int(mask.sum()) == pf.shape[0]
+    sols = mon.get_pf_solutions(mstate)
+    assert sols.shape[0] == pf.shape[0]
+    assert float(igd(pf, prob.pf())) < 0.2
+
+
+def test_eval_monitor_mo_archive_inf_objective_rows():
+    """A non-dominated row with an inf objective must not be counted as a
+    PF member nor leak through the eager getters (unified liveness)."""
+    mon = EvalMonitor(multi_obj=True, pf_capacity=8)
+    mon.set_opt_direction(jnp.ones((1,), dtype=jnp.float32))
+    cand = jnp.arange(12.0).reshape(6, 2)
+    fit = jnp.array(
+        [[0.1, 0.2], [jnp.inf, 0.0], [0.5, 0.1], [0.2, 0.15], [0.9, 0.9], [0.05, 0.4]]
+    )
+    ms = mon.init()
+    ms = mon.post_eval(ms, cand, fit)
+    pf = mon.get_pf_fitness(ms)
+    assert bool(jnp.isfinite(pf).all())
+    assert int(ms.pf_count) == int(mon.get_pf_mask(ms).sum())
+    assert pf.shape[0] == int(ms.pf_count)
