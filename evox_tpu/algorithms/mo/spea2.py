@@ -1,11 +1,11 @@
 """SPEA2 (Zitzler, Laumanns & Thiele 2001): strength-Pareto fitness with
-k-NN density and truncation-free archive selection. Capability parity with
-reference src/evox/algorithms/mo/spea2.py:71+.
-
-TPU note: the classic archive truncation removes one most-crowded point at a
-time; here truncation ranks by the lexicographic k-NN distance vector
-(the same ordering criterion) computed once — one sort instead of a
-data-dependent removal loop.
+k-NN density and the classic iterative archive truncation. Capability
+parity with reference src/evox/algorithms/mo/spea2.py:25-158: when the
+non-dominated set overflows the budget, the member with the smallest
+nearest-neighbor distance is removed one at a time (each removal updates
+its neighbors' distances — a one-shot sort would delete clustered pairs
+entirely instead of thinning them); otherwise the population fills by
+ascending strength-Pareto fitness.
 """
 
 from __future__ import annotations
@@ -50,9 +50,40 @@ class SPEA2(GAMOAlgorithm):
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
         dist = _masked_dist(fit)
         score = spea2_fitness(fit, dist)
-        dsort = jnp.sort(dist, axis=1)  # each row: ascending k-NN distances
-        # order: non-dominated first (score < 1), then by score; ties by
-        # larger nearest-neighbor distances (less crowded first)
-        order = jnp.lexsort((-dsort[:, 0], score))
+        nd_mask = score < 1.0  # raw fitness < 1 <=> non-dominated
+        n_valid = jnp.sum(nd_mask)
+
+        def by_fitness(_):
+            # front fits: take it whole, fill the rest by ascending score
+            return jnp.argsort(score)
+
+        def by_truncation(_):
+            # front overflows: iteratively drop the most crowded member
+            mask_mat = nd_mask[:, None] & nd_mask[None, :]
+            d0 = jnp.where(mask_mat, dist, jnp.inf)
+
+            def cond(carry):
+                keep, _ = carry
+                return jnp.sum(keep) > self.pop_size
+
+            def body(carry):
+                keep, d = carry
+                # clamp inf nn-distances to a finite sentinel so the argmin
+                # always lands on a KEPT row (rows of inf-coordinate points
+                # can be inf-distant from everyone, and an argmin over
+                # all-inf would return index 0 — possibly already removed,
+                # hanging the loop)
+                nn = jnp.minimum(jnp.min(d, axis=1), jnp.finfo(d.dtype).max)
+                idx = jnp.argmin(jnp.where(keep, nn, jnp.inf))
+                keep = keep.at[idx].set(False)
+                d = d.at[idx, :].set(jnp.inf).at[:, idx].set(jnp.inf)
+                return keep, d
+
+            keep, _ = jax.lax.while_loop(cond, body, (nd_mask, d0))
+            return jnp.argsort(~keep, stable=True)
+
+        order = jax.lax.cond(
+            n_valid <= self.pop_size, by_fitness, by_truncation, None
+        )
         idx = order[: self.pop_size]
         return pop[idx], fit[idx]

@@ -185,3 +185,18 @@ def test_rveaa_dtlz2_igd():
 
 def test_tdea_dtlz2_igd():
     assert _igd_after(build(TDEA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.15
+
+
+def test_spea2_truncation_inf_rows_terminate():
+    """Regression: inf-coordinate members in an overflowing front must not
+    hang the truncation loop."""
+    algo = SPEA2(jnp.zeros(4), jnp.ones(4), n_objs=2, pop_size=2)
+    fit = jnp.array(
+        [[0.0, jnp.inf], [jnp.inf, 0.0], [0.1, 0.9], [0.9, 0.1], [0.5, 0.5]]
+    )
+    pop = jnp.arange(20.0).reshape(5, 4)
+    from evox_tpu.algorithms.mo.common import MOState
+
+    state = MOState(population=pop, fitness=fit, offspring=pop, key=jax.random.PRNGKey(0))
+    sel_pop, sel_fit = jax.jit(algo.select)(state, pop, fit)
+    assert sel_fit.shape == (2, 2)
