@@ -54,3 +54,27 @@ def test_non_dominate_selection_keeps_first_front():
     pop = jnp.arange(6, dtype=jnp.float32)[:, None]
     sel_pop, sel_fit = non_dominate(pop, fit, 3)
     assert set(np.asarray(sel_pop)[:, 0].tolist()) == {0.0, 1.0, 2.0}
+
+
+def test_non_dominate_deduplicate():
+    """Duplicate decision vectors are pushed behind unique ones when
+    deduplicate=True (reference non_dominate.py:189-208)."""
+    pop = jnp.array([[0.0, 0.0], [1.0, 1.0], [0.0, 0.0], [2.0, 2.0]])
+    fit = jnp.array([[0.1, 0.9], [0.5, 0.5], [0.1, 0.9], [0.9, 0.1]])
+    sel_pop, sel_fit = non_dominate(pop, fit, 3, deduplicate=True)
+    # the duplicate of [0,0] must not appear twice among the selected
+    rows = [tuple(map(float, r)) for r in sel_pop]
+    assert rows.count((0.0, 0.0)) == 1
+
+
+def test_non_dominated_sort_many_objectives():
+    """m=10 ranks stay exact (bit-packed peel path)."""
+    rng = np.random.default_rng(0)
+    f = jnp.asarray(rng.random((200, 10)))
+    rank = non_dominated_sort(f)
+    # brute-force verify rank-0 members
+    fn = np.asarray(f)
+    dominated = (
+        (fn[None] <= fn[:, None]).all(-1) & (fn[None] < fn[:, None]).any(-1)
+    ).any(1)
+    np.testing.assert_array_equal(np.asarray(rank == 0), ~dominated)
