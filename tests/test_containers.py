@@ -98,3 +98,25 @@ def test_clustered_matches_structure():
     pop, state = algo.ask(state)
     assert pop.shape == (4, 20)  # CSO asks half the population
     state = algo.tell(state, jnp.arange(4.0))
+
+
+def test_containers_under_mesh():
+    """Decomposition containers run sharded: the vmapped sub-state's leading
+    (cluster) axis inherits the pop-axis annotation, distributing clusters
+    across devices (SURVEY §2.3: subpops map onto mesh axes)."""
+    from evox_tpu.core.distributed import create_mesh
+
+    dim, sub = 16, 4
+    base = PSO(-32.0 * jnp.ones(sub), 32.0 * jnp.ones(sub), pop_size=32)
+    mesh = create_mesh()
+    for cls, kw in (
+        (ClusteredAlgorithm, dict(num_clusters=4)),
+        (VectorizedCoevolution, dict(num_subpops=4)),
+    ):
+        algo = cls(base, dim=dim, **kw)
+        mon = EvalMonitor()
+        wf = StdWorkflow(algo, Ackley(), monitors=(mon,), mesh=mesh)
+        state = wf.init(jax.random.PRNGKey(0))
+        state = wf.run(state, 80)
+        best = float(mon.get_best_fitness(state.monitors[0]))
+        assert best < 1.0, f"{cls.__name__} sharded best {best}"
