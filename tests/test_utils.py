@@ -1,0 +1,142 @@
+"""Utils tests (reference tests/test_utils.py: TreeAndVector invertibility
+on nested pytrees — plus distances, aggregation, opt-direction, shaping,
+and frames2gif round-trips)."""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.utils import (
+    AggregationFunction,
+    TreeAndVector,
+    cos_dist,
+    dominate_relation,
+    frames2gif,
+    min_by,
+    pairwise_chebyshev_dist,
+    pairwise_euclidean_dist,
+    pairwise_manhattan_dist,
+    parse_opt_direction,
+    rank_based_fitness,
+)
+
+
+def _nested_tree(key):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "layer1": {"w": jax.random.normal(k1, (3, 4)), "b": jax.random.normal(k2, (4,))},
+        "layer2": (jax.random.normal(k3, (2, 2)), jnp.float32(1.5)),
+    }
+
+
+def test_tree_and_vector_roundtrip():
+    tree = _nested_tree(jax.random.PRNGKey(0))
+    adapter = TreeAndVector(tree)
+    vec = adapter.to_vector(tree)
+    assert vec.ndim == 1 and vec.shape[0] == adapter.dim == 3 * 4 + 4 + 4 + 1
+    back = adapter.to_tree(vec)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_tree_and_vector_batched():
+    tree = _nested_tree(jax.random.PRNGKey(1))
+    adapter = TreeAndVector(tree)
+    src_vecs = jax.random.normal(jax.random.PRNGKey(2), (5, adapter.dim))
+    batch = jax.vmap(adapter.to_tree)(src_vecs)
+    vecs = adapter.batched_to_vector(batch)
+    assert vecs.shape == (5, adapter.dim)
+    # full cycle reproduces the ORIGINAL vectors (a self-consistent
+    # scrambling of segments would otherwise pass)
+    np.testing.assert_allclose(np.asarray(vecs), np.asarray(src_vecs), rtol=1e-6)
+    back = adapter.batched_to_tree(vecs)
+    for a, b in zip(jax.tree.leaves(batch), jax.tree.leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_tree_and_vector_picklable():
+    adapter = TreeAndVector(_nested_tree(jax.random.PRNGKey(3)))
+    clone = pickle.loads(pickle.dumps(adapter))
+    v = jnp.arange(adapter.dim, dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(adapter.to_tree(v)), jax.tree.leaves(clone.to_tree(v))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_pairwise_distances_golden():
+    x = jnp.array([[0.0, 0.0], [3.0, 4.0]])
+    np.testing.assert_allclose(
+        np.asarray(pairwise_euclidean_dist(x, x)), [[0, 5], [5, 0]], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_manhattan_dist(x, x)), [[0, 7], [7, 0]], atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_chebyshev_dist(x, x)), [[0, 4], [4, 0]], atol=1e-6
+    )
+    y = jnp.array([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+    c = np.asarray(cos_dist(y, y))
+    np.testing.assert_allclose(np.diagonal(c), 1.0, atol=1e-6)
+    np.testing.assert_allclose(c[0, 1], 0.0, atol=1e-6)
+
+
+def test_dominate_relation():
+    f = jnp.array([[0.0, 0.0], [1.0, 1.0], [0.0, 1.0], [1.0, 0.0]])
+    d = np.asarray(dominate_relation(f, f))
+    assert d[0, 1] and d[0, 2] and d[0, 3]
+    assert not d[2, 3] and not d[3, 2]
+    assert not np.diagonal(d).any()
+
+
+def test_parse_opt_direction():
+    np.testing.assert_array_equal(np.asarray(parse_opt_direction("min")), [1.0])
+    np.testing.assert_array_equal(np.asarray(parse_opt_direction("max")), [-1.0])
+    np.testing.assert_array_equal(
+        np.asarray(parse_opt_direction(["min", "max"])), [1.0, -1.0]
+    )
+
+
+def test_rank_based_fitness_centered():
+    f = jnp.array([3.0, 1.0, 2.0])
+    shaped = np.asarray(rank_based_fitness(f))
+    assert shaped.sum() == pytest.approx(0.0, abs=1e-6)
+    # ordering preserved: best (smallest) gets the smallest shaped value
+    assert shaped[1] < shaped[2] < shaped[0]
+
+
+def test_min_by():
+    values = [jnp.array([[1.0], [2.0]]), jnp.array([[3.0]])]
+    keys = [jnp.array([5.0, 2.0]), jnp.array([3.0])]
+    best, best_key = min_by(values, keys)
+    assert float(best_key) == 2.0
+    np.testing.assert_array_equal(np.asarray(best), [2.0])
+
+
+def test_aggregation_functions():
+    f = jnp.array([[1.0, 2.0]])
+    w = jnp.array([[0.5, 0.5]])
+    ideal = jnp.zeros((2,))
+    ws = AggregationFunction("weighted_sum")(f, w, ideal)
+    np.testing.assert_allclose(np.asarray(ws), [1.5], atol=1e-6)
+    tch = AggregationFunction("tchebycheff")(f, w, ideal)
+    np.testing.assert_allclose(np.asarray(tch), [1.0], atol=1e-6)
+    # pbi golden: d1 = |f.w_hat| = 1.5/sqrt(0.5), d2 = ||f - d1*w_hat||,
+    # pbi = d1 + 5*d2
+    pbi = AggregationFunction("pbi")(f, w, ideal)
+    d1 = 1.5 / np.sqrt(0.5)
+    d2 = np.linalg.norm(np.array([1.0, 2.0]) - d1 * np.array([0.5, 0.5]) / np.sqrt(0.5))
+    np.testing.assert_allclose(np.asarray(pbi), [d1 + 5.0 * d2], rtol=1e-5)
+
+
+def test_frames2gif_roundtrip(tmp_path):
+    frames = [np.full((8, 8, 3), v, dtype=np.uint8) for v in (0, 128, 255)]
+    path = str(tmp_path / "anim.gif")
+    frames2gif(frames, path, duration=0.05)
+    assert os.path.getsize(path) > 0
+    from PIL import Image
+
+    with Image.open(path) as im:
+        assert im.n_frames == 3
