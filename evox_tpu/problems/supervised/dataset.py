@@ -29,18 +29,7 @@ import numpy as np
 from jax.experimental import io_callback
 
 from ...core.problem import Problem
-
-_X64_MAP = {np.dtype(np.float64): np.float32, np.dtype(np.int64): np.int32}
-
-
-def _to_x32(batch: Any) -> Any:
-    """Coerce 64-bit host arrays to 32-bit (reference utils/io.py:6-26):
-    JAX defaults to x32, and the io_callback signature must match exactly."""
-    def fix(x):
-        x = np.asarray(x)
-        return x.astype(_X64_MAP[x.dtype]) if x.dtype in _X64_MAP else x
-
-    return jax.tree.map(fix, batch)
+from ...utils.io import to_x32_if_needed as _to_x32
 
 
 def _shape_dtypes(batch: Any) -> Any:

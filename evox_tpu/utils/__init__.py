@@ -13,6 +13,7 @@ from .common import (
     frames2gif,
 )
 from .aggregation import AggregationFunction
+from .io import to_x32_if_needed, x32_func_call
 from .optimizers import clipup, make_optimizer
 
 __all__ = [
@@ -27,6 +28,8 @@ __all__ = [
     "cos_dist",
     "dominate_relation",
     "frames2gif",
+    "to_x32_if_needed",
+    "x32_func_call",
     "new_key",
     "AggregationFunction",
     "clipup",

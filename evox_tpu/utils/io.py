@@ -1,0 +1,45 @@
+"""x32 coercion helpers (reference src/evox/utils/io.py:6-26).
+
+JAX defaults to 32-bit; host libraries (numpy loaders, gym envs, EnvPool)
+hand back 64-bit arrays whose dtypes must match declared io_callback /
+pure_callback signatures exactly."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+_X64_MAP = {np.dtype(np.float64): np.float32, np.dtype(np.int64): np.int32}
+
+
+def to_x32_if_needed(values: Any) -> Any:
+    """Coerce 64-bit leaves of a pytree to their 32-bit counterparts.
+
+    Matches the reference's semantics: a no-op when ``jax_enable_x64`` is
+    on (64-bit data is then representable on device), and leaves without a
+    64-bit dtype — including device ``jax.Array``s and Python scalars —
+    pass through untouched (no host transfer, no conversion)."""
+    if jax.config.jax_enable_x64:
+        return values
+
+    def fix(x):
+        dt = getattr(x, "dtype", None)
+        if dt is not None and np.dtype(dt) in _X64_MAP:
+            return np.asarray(x).astype(_X64_MAP[np.dtype(dt)])
+        return x
+
+    return jax.tree.map(fix, values)
+
+
+def x32_func_call(func: Callable) -> Callable:
+    """Wrap a host function so its outputs are x32-coerced (decorator form,
+    for callbacks handed to io_callback/pure_callback)."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return to_x32_if_needed(func(*args, **kwargs))
+
+    return wrapper

@@ -140,3 +140,14 @@ def test_frames2gif_roundtrip(tmp_path):
 
     with Image.open(path) as im:
         assert im.n_frames == 3
+
+
+def test_to_x32_passthrough_semantics():
+    from evox_tpu.utils import to_x32_if_needed
+
+    out = to_x32_if_needed(
+        {"a": np.arange(3, dtype=np.int64), "b": jnp.ones((2,)), "c": 5}
+    )
+    assert out["a"].dtype == np.int32
+    assert isinstance(out["b"], jax.Array)  # device array untouched
+    assert out["c"] == 5
