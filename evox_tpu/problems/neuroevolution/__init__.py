@@ -9,6 +9,7 @@ from .policy import mlp_policy
 from .control import envs
 from .hostenv import HostEnvProblem, HostVectorEnv, NumpyCartPoleVec, envpool_make
 from .rollout_farm import HostRolloutFarm
+from ._native import NativeVectorEnv, native_available
 
 __all__ = [
     "Trajectory",
@@ -17,6 +18,8 @@ __all__ = [
     "NumpyCartPoleVec",
     "envpool_make",
     "HostRolloutFarm",
+    "NativeVectorEnv",
+    "native_available",
     "CapEpisode",
     "ObsNormalizer",
     "PolicyRolloutProblem",

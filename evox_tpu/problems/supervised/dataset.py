@@ -79,16 +79,23 @@ class DatasetProblem(Problem):
 
     def __init__(self, iterator: Iterator[Any], loss_func: Callable):
         self.loss_func = loss_func
-        probe = _to_x32(next(iterator))
+        probe = self._coerce(next(iterator))
         self.data_shape_dtypes = _shape_dtypes(probe)
         self._pending = probe
         self._iterator = iterator
+
+    @staticmethod
+    def _coerce(batch: Any) -> Any:
+        # materialize every leaf (loaders may yield Python scalars/lists,
+        # which must become arrays matching the declared callback dtypes)
+        # before the shared x32 narrowing
+        return _to_x32(jax.tree.map(np.asarray, batch))
 
     def _next_data(self) -> Any:
         if self._pending is not None:
             batch, self._pending = self._pending, None
             return batch
-        return _to_x32(next(self._iterator))
+        return self._coerce(next(self._iterator))
 
     def evaluate(self, state, pop):
         data = io_callback(self._next_data, self.data_shape_dtypes, ordered=True)

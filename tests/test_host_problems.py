@@ -14,8 +14,10 @@ from evox_tpu.monitors import EvalMonitor
 from evox_tpu.problems.neuroevolution import (
     HostEnvProblem,
     HostRolloutFarm,
+    NativeVectorEnv,
     NumpyCartPoleVec,
     mlp_policy,
+    native_available,
 )
 from evox_tpu.problems.supervised import DatasetProblem, InMemoryDataLoader
 from evox_tpu.utils import TreeAndVector
@@ -80,6 +82,21 @@ def test_dataset_problem_batch_order_deterministic():
     assert not np.allclose(fits[0][0], fits[0][1])
 
 
+def test_dataset_problem_scalar_leaves():
+    """Loaders may yield plain Python scalars; they must be materialized to
+    arrays whose dtypes match the declared io_callback signature."""
+
+    def gen():
+        while True:
+            yield {"x": np.ones((4, 2), np.float32), "w": 0.5, "k": 3}
+
+    prob = DatasetProblem(
+        gen(), lambda p, b: jnp.sum(p) * b["w"] + jnp.sum(b["x"]) + b["k"]
+    )
+    fit, _ = jax.jit(prob.evaluate)(None, jnp.ones((3, 2)))
+    np.testing.assert_allclose(np.asarray(fit), np.full((3,), 2 * 0.5 + 8 + 3))
+
+
 def test_x64_coercion():
     data = {"x": np.arange(8, dtype=np.int64), "y": np.ones(8, dtype=np.float64)}
     prob = DatasetProblem(
@@ -122,6 +139,105 @@ def test_host_env_problem_cartpole():
         first_state = wf.step(first_state)
     best = float(mon.get_best_fitness(first_state.monitors[0]))
     assert best > 50.0, f"host cartpole best {best}"
+
+
+# ----------------------------------------------------- native C++ vec env
+
+
+@pytest.fixture(scope="module")
+def native():
+    """Build/load the C++ engine lazily (never during collection)."""
+    if not native_available():
+        pytest.skip("no C++ toolchain for the native vecenv")
+
+
+def test_native_vecenv_matches_numpy_cartpole(native):
+    """The C++ engine and the numpy host env share dynamics to the last
+    ulp once their states are synced (both integrate in float64 with the
+    same association and no FP contraction). Observations are compared at
+    1e-12 rather than bit-for-bit: numpy may dispatch sin/cos to SIMD
+    kernels (SVML) that differ from libm in the final ulp."""
+    n = 64
+    cxx = NativeVectorEnv("cartpole", n, max_steps=100)
+    ref = NumpyCartPoleVec(num_envs=n, max_steps=100)
+    ref.reset(123)
+    cxx.reset(0)
+    cxx.set_state(ref._s.copy())
+    rng = np.random.default_rng(7)
+    for t in range(120):  # crosses the truncation horizon
+        a = rng.standard_normal((n, 2)).astype(np.float32)
+        o1, r1, te1, tr1 = ref.step(a)
+        o2, r2, te2, tr2 = cxx.step(a)
+        np.testing.assert_allclose(
+            o1, o2, rtol=1e-12, atol=1e-12, err_msg=f"obs step {t}"
+        )
+        np.testing.assert_array_equal(r1, r2, err_msg=f"reward step {t}")
+        np.testing.assert_array_equal(te1, te2, err_msg=f"terminated step {t}")
+        np.testing.assert_array_equal(tr1, tr2, err_msg=f"truncated step {t}")
+
+
+def test_native_vecenv_matches_jax_pendulum(native):
+    """One step of the C++ pendulum matches the pure-JAX EnvSpec dynamics
+    (float32 tolerance: the JAX env integrates in f32, the engine in f64)."""
+    from evox_tpu.problems.neuroevolution.control import envs
+
+    n = 16
+    spec = envs.pendulum(max_steps=50)
+    cxx = NativeVectorEnv("pendulum", n, max_steps=50)
+    cxx.reset(3)
+    state0 = cxx.get_state()
+    actions = np.linspace(-2.5, 2.5, n, dtype=np.float32)[:, None]
+
+    def jax_step(s, a):
+        new_s, reward, _ = spec.step(jnp.asarray(s, dtype=jnp.float32), a)
+        return spec.obs(new_s), reward
+
+    jobs, jrew = jax.vmap(jax_step)(jnp.asarray(state0), jnp.asarray(actions))
+    cobs, crew, cterm, _ = cxx.step(actions)
+    np.testing.assert_allclose(cobs, np.asarray(jobs), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(crew, np.asarray(jrew), rtol=1e-5, atol=1e-5)
+    assert not cterm.any()  # pendulum never terminates
+
+
+def test_native_vecenv_threads_deterministic(native):
+    """num_threads must not change results (per-env RNG streams)."""
+    a = NativeVectorEnv("acrobot", 33, max_steps=60, num_threads=1)
+    b = NativeVectorEnv("acrobot", 33, max_steps=60, num_threads=4)
+    o1, o2 = a.reset(9), b.reset(9)
+    np.testing.assert_array_equal(o1, o2)
+    rng = np.random.default_rng(11)
+    for _ in range(30):
+        act = rng.standard_normal((33, 3)).astype(np.float32)
+        r1 = a.step(act)
+        r2 = b.step(act)
+        for x, y in zip(r1, r2):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_native_vecenv_trains_cartpole(native):
+    """End-to-end: the C++ engine behind HostEnvProblem trains a policy."""
+    pop_size = 32
+    apply, adapter = _policy_setup(pop_size)
+    env = NativeVectorEnv("cartpole", pop_size, max_steps=200)
+    prob = HostEnvProblem(apply, env, cap_episode_length=200)
+    algo = PSO(
+        lb=-2.0 * jnp.ones(adapter.dim),
+        ub=2.0 * jnp.ones(adapter.dim),
+        pop_size=pop_size,
+    )
+    mon = EvalMonitor()
+    wf = StdWorkflow(
+        algo,
+        prob,
+        monitors=(mon,),
+        opt_direction="max",
+        pop_transforms=(adapter.batched_to_tree,),
+    )
+    state = wf.init(jax.random.PRNGKey(1))
+    for _ in range(15):
+        state = wf.step(state)
+    best = float(mon.get_best_fitness(state.monitors[0]))
+    assert best > 50.0, f"native cartpole best {best}"
 
 
 # ----------------------------------------------------------- rollout farm
