@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for hot operators.
+
+XLA's default lowerings handle most of the framework well; these kernels
+cover the cases where they don't. Each kernel ships with a pure-XLA
+fallback of identical semantics, selected explicitly via ``use_pallas``,
+and is unit-tested against the fallback in interpret mode so the CPU mesh
+CI exercises the kernel body too. Where measurement shows the fallback
+already at the hardware roofline (see each kernel's docstring), the
+fallback stays the default.
+"""
+
+from .dominance import packed_dominance, packed_dominance_reference
+
+__all__ = ["packed_dominance", "packed_dominance_reference"]

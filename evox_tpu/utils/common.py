@@ -124,10 +124,19 @@ def cos_dist(x: jax.Array, y: jax.Array) -> jax.Array:
 def dominate_relation(x: jax.Array, y: jax.Array) -> jax.Array:
     """Boolean (n, m) matrix: ``out[i, j]`` iff ``x[i]`` Pareto-dominates ``y[j]``.
 
-    Minimization convention (reference: utils/common.py:94-97).
+    Minimization convention (reference: utils/common.py:94-97). Formulated
+    as a static loop over the (small) objective axis so every compare is an
+    (n, n) pass with the population in the TPU lane dimension — the
+    broadcast-compare form puts m in the lanes and measures ~2x slower at
+    n=20000 on v5e.
     """
-    le = jnp.all(x[:, None, :] <= y[None, :, :], axis=-1)
-    lt = jnp.any(x[:, None, :] < y[None, :, :], axis=-1)
+    le = jnp.ones((x.shape[0], y.shape[0]), dtype=jnp.bool_)
+    lt = jnp.zeros((x.shape[0], y.shape[0]), dtype=jnp.bool_)
+    for k in range(x.shape[1]):
+        xk = x[:, k][:, None]
+        yk = y[:, k][None, :]
+        le &= xk <= yk
+        lt |= xk < yk
     return le & lt
 
 

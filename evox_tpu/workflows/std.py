@@ -71,6 +71,15 @@ class StdWorkflow:
             defaults to ``not problem.jittable``.
         num_objectives: fitness arity used to declare callback output shapes.
         jit_step: disable to debug eagerly.
+        migrate_helper: optional jittable callable ``() -> (do_migrate,
+            foreign_pop, foreign_fitness)`` polled once per generation; when
+            ``do_migrate`` is True the algorithm's ``migrate(state, pop,
+            fitness) -> state`` ingests the foreign individuals under a
+            ``lax.cond`` (the reference's human-in-the-loop migration slot,
+            std_workflow.py:230-244). For live injection the helper should
+            pull data through ``io_callback``/``pure_callback`` internally —
+            a plain closure is traced once and its values baked into the
+            compiled step.
         eval_shard_map: evaluate inside an explicit ``jax.shard_map`` island
             — each device scores only its population shard, then the fitness
             is ``all_gather``-ed (tiled) over ICI. Semantically identical to
@@ -100,6 +109,7 @@ class StdWorkflow:
         jit_step: bool = True,
         eval_shard_map: bool = False,
         allow_uneven_shards: bool = False,
+        migrate_helper: Optional[Callable] = None,
     ):
         self.algorithm = algorithm
         self.problem = problem
@@ -111,6 +121,14 @@ class StdWorkflow:
         self.num_objectives = num_objectives
         self.external = (not problem.jittable) if external_problem is None else external_problem
         self.eval_shard_map = eval_shard_map
+        self.migrate_helper = migrate_helper
+        if migrate_helper is not None and not callable(
+            getattr(algorithm, "migrate", None)
+        ):
+            raise ValueError(
+                "migrate_helper requires the algorithm to define "
+                "migrate(state, pop, fitness) -> state"
+            )
         if eval_shard_map and (mesh is None or self.external):
             raise ValueError(
                 "eval_shard_map requires a mesh and a jittable problem"
@@ -293,6 +311,15 @@ class StdWorkflow:
             astate = self.algorithm.init_tell(astate, fitness)
         else:
             astate = self.algorithm.tell(astate, fitness)
+        if self.migrate_helper is not None:
+            do_migrate, foreign_pop, foreign_fit = self.migrate_helper()
+            astate = jax.lax.cond(
+                do_migrate,
+                lambda a: self.algorithm.migrate(a, foreign_pop, foreign_fit),
+                lambda a: a,
+                astate,
+            )
+
         # apply per-field sharding annotations (field(sharding=...)) so the
         # loop-carried algorithm state keeps its declared mesh layout
         astate = constrain_state(astate, self.mesh)

@@ -248,3 +248,42 @@ def test_eval_monitor_mo_archive_inf_objective_rows():
     assert bool(jnp.isfinite(pf).all())
     assert int(ms.pf_count) == int(mon.get_pf_mask(ms).sum())
     assert pf.shape[0] == int(ms.pf_count)
+
+
+def test_migrate_helper_injects_foreign_individuals():
+    """Human-in-the-loop migration slot (reference std_workflow.py:230-244):
+    a jittable helper feeds (do_migrate, pop, fit) and the algorithm's
+    migrate() ingests them under lax.cond."""
+    from evox_tpu.algorithms.so.pso.pso import PSO as BasePSO
+
+    class MigratablePSO(BasePSO):
+        def migrate(self, state, pop, fitness):
+            # replace the worst personal bests with the migrants
+            k = pop.shape[0]
+            order = jnp.argsort(-state.pbest_fitness)  # worst first
+            idx = order[:k]
+            return state.replace(
+                population=state.population.at[idx].set(pop),
+                pbest_position=state.pbest_position.at[idx].set(pop),
+                pbest_fitness=state.pbest_fitness.at[idx].set(fitness),
+            )
+
+    foreign = jnp.zeros((4, 2))  # the optimum of Sphere
+    foreign_fit = jnp.zeros((4,))
+
+    def helper():
+        return jnp.asarray(True), foreign, foreign_fit
+
+    algo = MigratablePSO(
+        lb=jnp.full((2,), -10.0), ub=jnp.full((2,), 10.0), pop_size=16
+    )
+    wf = StdWorkflow(algo, Sphere(), migrate_helper=helper)
+    state = run_workflow(wf, 2)
+    # migrants (perfect fitness 0) must now dominate the personal bests
+    assert float(jnp.sort(state.algo.pbest_fitness)[3]) == 0.0
+
+
+def test_migrate_helper_requires_migrate_method():
+    algo = PSO(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
+    with pytest.raises(ValueError, match="migrate"):
+        StdWorkflow(algo, Sphere(), migrate_helper=lambda: None)
