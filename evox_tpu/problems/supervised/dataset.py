@@ -77,12 +77,21 @@ class DatasetProblem(Problem):
     population on it — the reference's semantics (tfds.py:133-136).
     """
 
-    def __init__(self, iterator: Iterator[Any], loss_func: Callable):
+    def __init__(
+        self,
+        iterator: Iterator[Any],
+        loss_func: Callable,
+        valid_iterator: Optional[Iterator[Any]] = None,
+        valid_loss_func: Optional[Callable] = None,
+    ):
         self.loss_func = loss_func
         probe = self._coerce(next(iterator))
         self.data_shape_dtypes = _shape_dtypes(probe)
         self._pending = probe
         self._iterator = iterator
+        self._valid_iterator = valid_iterator
+        self._valid_loss_func = valid_loss_func
+        self._valid_problem: Optional["DatasetProblem"] = None
 
     @staticmethod
     def _coerce(batch: Any) -> Any:
@@ -102,11 +111,50 @@ class DatasetProblem(Problem):
         loss = jax.vmap(self.loss_func, in_axes=(0, None))(pop, data)
         return loss, state
 
+    def valid(self, metric: Optional[Callable] = None) -> "Problem":
+        """Validation-mode twin over the held-out iterator (the capability
+        behind the reference Ray workflow's ``valid(metric)`` hook,
+        distributed.py:145-156). ``metric`` overrides the scoring function
+        (default: ``valid_loss_func`` or the training loss). Feed the
+        result to ``StdWorkflow.validate``. The twin is constructed once
+        (one probe batch) and cached; metric overrides are lightweight
+        views sharing the twin's stream, so every validation call —
+        whatever its metric — advances the same validation iterator."""
+        if self._valid_iterator is None:
+            raise ValueError(
+                "no valid_iterator was provided at construction; pass one "
+                "to use validation mode"
+            )
+        if self._valid_problem is None:
+            self._valid_problem = DatasetProblem(
+                self._valid_iterator,
+                self._valid_loss_func or self.loss_func,
+            )
+        if metric is None:
+            return self._valid_problem
+        return _MetricView(self._valid_problem, metric)
+
+
+class _MetricView(Problem):
+    """A scoring-function override sharing its base problem's data stream."""
+
+    def __init__(self, base: DatasetProblem, metric: Callable):
+        self.base = base
+        self.metric = metric
+
+    def evaluate(self, state, pop):
+        data = io_callback(
+            self.base._next_data, self.base.data_shape_dtypes, ordered=True
+        )
+        return jax.vmap(self.metric, in_axes=(0, None))(pop, data), state
+
 
 class TensorflowDataset(DatasetProblem):
     """TFDS + grain dataloader behind :class:`DatasetProblem` (reference
     tfds.py:27-131). Requires ``tensorflow-datasets`` and ``grain``, which
-    are optional; importing this class without them raises ImportError."""
+    are optional; importing this class without them raises ImportError.
+    Pass ``valid_split="test"`` to enable ``valid()`` validation mode over
+    a held-out TFDS split."""
 
     def __init__(
         self,
@@ -114,6 +162,8 @@ class TensorflowDataset(DatasetProblem):
         batch_size: int,
         loss_func: Callable,
         split: str = "train",
+        valid_split: Optional[str] = None,
+        valid_loss_func: Optional[Callable] = None,
         operations: Optional[list] = None,
         datadir: Optional[str] = None,
         seed: int = 0,
@@ -128,17 +178,34 @@ class TensorflowDataset(DatasetProblem):
                 "`grain`; use DatasetProblem + InMemoryDataLoader instead"
             ) from e
         kwargs = {} if datadir is None else {"data_dir": datadir}
-        source = tfds.data_source(dataset, try_gcs=try_gcs, split=split, **kwargs)
-        sampler = pygrain.IndexSampler(
-            num_records=len(source),
-            shard_options=pygrain.NoSharding(),
-            shuffle=True,
-            seed=seed,
+
+        def make_loader(which_split: str, loader_seed: int):
+            source = tfds.data_source(
+                dataset, try_gcs=try_gcs, split=which_split, **kwargs
+            )
+            sampler = pygrain.IndexSampler(
+                num_records=len(source),
+                shard_options=pygrain.NoSharding(),
+                shuffle=True,
+                seed=loader_seed,
+            )
+            ops = list(operations or []) + [
+                pygrain.Batch(batch_size=batch_size, drop_remainder=True)
+            ]
+            return iter(
+                pygrain.DataLoader(
+                    data_source=source,
+                    operations=ops,
+                    sampler=sampler,
+                    worker_count=0,
+                )
+            )
+
+        super().__init__(
+            make_loader(split, seed),
+            loss_func,
+            valid_iterator=(
+                make_loader(valid_split, seed + 1) if valid_split else None
+            ),
+            valid_loss_func=valid_loss_func,
         )
-        ops = list(operations or []) + [
-            pygrain.Batch(batch_size=batch_size, drop_remainder=True)
-        ]
-        loader = pygrain.DataLoader(
-            data_source=source, operations=ops, sampler=sampler, worker_count=0
-        )
-        super().__init__(iter(loader), loss_func)

@@ -207,6 +207,51 @@ class StdWorkflow:
             state = self._run_loop(state, jnp.asarray(n_steps, dtype=jnp.int32))
         return state
 
+    def _ask_preview(self, state: StdWorkflowState) -> Any:
+        """ask() with the same first-step init_ask dispatch as the step."""
+        if state.first_step and (
+            self.algorithm.has_init_ask or self.algorithm.has_init_tell
+        ):
+            pop, _ = self.algorithm.init_ask(state.algo)
+        else:
+            pop, _ = self.algorithm.ask(state.algo)
+        return pop
+
+    def sample(self, state: StdWorkflowState) -> Any:
+        """The population the algorithm would propose next, without
+        advancing the workflow (the Ray workflow's ``sample`` path,
+        reference distributed.py:156,384-386)."""
+        return self._ask_preview(state)
+
+    def validate(
+        self, state: StdWorkflowState, problem: Optional[Problem] = None
+    ) -> jax.Array:
+        """Score the current population on ``problem`` without ``tell``.
+
+        The mesh-native analog of the Ray workflow's ``valid`` path
+        (reference distributed.py:145-156,381-383): ask, transform,
+        evaluate — no algorithm-state advance, no fitness sign flip.
+        ``problem`` defaults to the training problem; pass a
+        validation-mode problem (e.g. ``DatasetProblem.valid()``) to score
+        on held-out data. Eager utility: the validation problem's state is
+        created ad hoc.
+
+        Caveat: a training problem that consumes a host stream during
+        ``evaluate`` (``DatasetProblem``, host env loops) still consumes
+        one draw when validated on — pass a validation problem to keep the
+        training stream untouched.
+        """
+        problem = problem if problem is not None else self.problem
+        cand = self._ask_preview(state)
+        for t in self.pop_transforms:
+            cand = t(cand)
+        cand = shard_pop(cand, self.mesh)
+        if problem is self.problem:
+            fitness, _ = self._evaluate(state.prob, cand)
+        else:
+            fitness, _ = problem.evaluate(problem.init(), cand)
+        return fitness
+
     def _run_hooks(self, name: str, mstates: list, *args: Any) -> None:
         for i in self._hook_table[name]:
             mstates[i] = getattr(self.monitors[i], name)(mstates[i], *args)
@@ -313,6 +358,11 @@ class StdWorkflow:
             astate = self.algorithm.tell(astate, fitness)
         if self.migrate_helper is not None:
             do_migrate, foreign_pop, foreign_fit = self.migrate_helper()
+            # foreign fitness arrives in the user's convention: apply the
+            # sign flip so it meets the algorithm's internal minimization
+            # state — but NOT fit_transforms, which are population-relative
+            # (rank shaping over a lone migrant batch is meaningless/NaN)
+            foreign_fit = self._flip(foreign_fit)
             astate = jax.lax.cond(
                 do_migrate,
                 lambda a: self.algorithm.migrate(a, foreign_pop, foreign_fit),

@@ -336,3 +336,32 @@ def test_rollout_farm_seeds_vary_across_generations():
     # identical state every call; near-zero policy -> fitness differs only
     # through the episode seeds, which must vary
     assert any(not np.allclose(fits[0], f) for f in fits[1:])
+
+
+def test_dataset_problem_validation_mode():
+    """DatasetProblem.valid() scores on the held-out stream; used through
+    StdWorkflow.validate without advancing training."""
+    data, loss, w_true = _linreg_setup(seed=1)
+    # held-out split: fresh inputs, SAME ground-truth weights
+    vrng = np.random.default_rng(5)
+    Xv = vrng.normal(size=(256, len(w_true))).astype(np.float32)
+    valid_data = {"x": Xv, "y": (Xv @ w_true).astype(np.float32)}
+    prob = DatasetProblem(
+        InMemoryDataLoader(data, batch_size=64, seed=3),
+        loss,
+        valid_iterator=InMemoryDataLoader(valid_data, batch_size=128, seed=4),
+    )
+    d = len(w_true)
+    algo = OpenES(center_init=jnp.zeros(d), pop_size=64, learning_rate=0.1, noise_stdev=0.2)
+    wf = StdWorkflow(algo, prob)
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.run(state, 120)
+    train_fit = wf.validate(state)
+    val_fit = wf.validate(state, problem=prob.valid())
+    # trained center generalizes: population means on both streams are low
+    assert float(jnp.mean(train_fit)) < 2.0
+    assert float(jnp.mean(val_fit)) < 2.0
+    # a custom metric (mean absolute error) routes through valid(metric=...)
+    mae = prob.valid(metric=lambda w, b: jnp.mean(jnp.abs(b["x"] @ w - b["y"])))
+    mae_fit = wf.validate(state, problem=mae)
+    assert mae_fit.shape == val_fit.shape

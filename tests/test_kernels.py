@@ -87,3 +87,11 @@ def test_non_dominated_sort_unchanged_by_build_path():
         active &= ~front
         r += 1
     np.testing.assert_array_equal(ranks, expect)
+
+
+def test_packed_dominance_rejects_bad_tiles():
+    fit = jax.random.uniform(jax.random.PRNGKey(0), (16, 2))
+    with pytest.raises(ValueError, match="tile_i"):
+        packed_dominance(fit, use_pallas=True, interpret=True, tile_i=48)
+    with pytest.raises(ValueError, match="tile_j"):
+        packed_dominance(fit, use_pallas=True, interpret=True, tile_j=100)

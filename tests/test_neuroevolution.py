@@ -284,3 +284,49 @@ def test_scan_rollout_rejects_cap_episode():
         PolicyRolloutProblem(
             apply, env, early_exit=False, cap_episode=CapEpisode()
         )
+
+
+def test_mlp_policy_matches_matmul_form():
+    """The VPU-friendly broadcast-multiply-reduce layers must compute the
+    exact same function as the plain matmul formulation, including under
+    the rollout's (pop, episodes) double-vmap."""
+    import numpy as np
+
+    init_params, apply = mlp_policy((5, 16, 3))
+    params = init_params(jax.random.PRNGKey(0))
+
+    def apply_matmul(params, obs):
+        h = obs
+        for i, layer in enumerate(params):
+            h = h @ layer["w"] + layer["b"]
+            if i < len(params) - 1:
+                h = jnp.tanh(h)
+        return h
+
+    obs = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 5))
+    batched = jax.vmap(jax.vmap(apply, in_axes=(None, 0)), in_axes=(None, 0))
+    batched_mm = jax.vmap(
+        jax.vmap(apply_matmul, in_axes=(None, 0)), in_axes=(None, 0)
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched(params, obs)),
+        np.asarray(batched_mm(params, obs)),
+        rtol=1e-6,
+        atol=1e-6,
+    )
+
+
+def test_mlp_policy_layer_form_selection():
+    """Wide layers keep @ (MXU), tiny layers broadcast-reduce (VPU); both
+    forms and the forced flags compute the same function."""
+    import numpy as np
+
+    obs = jax.random.normal(jax.random.PRNGKey(2), (3, 80))
+    for force in (None, True, False):
+        init_params, apply = mlp_policy((80, 128, 4), use_matmul=force)
+        params = init_params(jax.random.PRNGKey(0))
+        out = np.asarray(apply(params, obs))
+        if force is None:
+            base = out
+        else:
+            np.testing.assert_allclose(out, base, rtol=1e-5, atol=1e-5)

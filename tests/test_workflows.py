@@ -287,3 +287,67 @@ def test_migrate_helper_requires_migrate_method():
     algo = PSO(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
     with pytest.raises(ValueError, match="migrate"):
         StdWorkflow(algo, Sphere(), migrate_helper=lambda: None)
+
+
+def test_sample_and_validate():
+    """sample() previews the next population; validate() scores it on a
+    problem without advancing the workflow (reference Ray workflow's
+    sample/valid paths, distributed.py:145-156,381-386)."""
+    algo = PSO(lb=jnp.full((3,), -5.0), ub=jnp.full((3,), 5.0), pop_size=12)
+    wf = StdWorkflow(algo, Sphere())
+    state = run_workflow(wf, 3)
+    pop = wf.sample(state)
+    assert pop.shape == (12, 3)
+    fit = wf.validate(state)
+    import numpy as np
+
+    np.testing.assert_allclose(
+        np.asarray(fit), np.asarray((pop**2).sum(axis=1)), rtol=1e-6
+    )
+    # no state advance: sampling and validating twice is idempotent
+    np.testing.assert_array_equal(np.asarray(wf.sample(state)), np.asarray(pop))
+    # validating on a different problem
+    fit2 = wf.validate(state, problem=Ackley())
+    assert fit2.shape == (12,)
+    assert not np.allclose(np.asarray(fit2), np.asarray(fit))
+
+
+def test_migrate_helper_respects_opt_direction():
+    """Foreign fitness arrives in the user's convention and must get the
+    same sign flip as every other fitness before entering the algorithm."""
+    from evox_tpu.algorithms.so.pso.pso import PSO as BasePSO
+
+    class MigratablePSO(BasePSO):
+        def migrate(self, state, pop, fitness):
+            k = pop.shape[0]
+            idx = jnp.argsort(-state.pbest_fitness)[:k]
+            return state.replace(
+                pbest_position=state.pbest_position.at[idx].set(pop),
+                pbest_fitness=state.pbest_fitness.at[idx].set(fitness),
+            )
+
+    def helper():
+        # raw (maximization) fitness 5.0 — internally this must become -5.0
+        return jnp.asarray(True), jnp.zeros((4, 2)), jnp.full((4,), 5.0)
+
+    algo = MigratablePSO(
+        lb=jnp.full((2,), -1.0), ub=jnp.full((2,), 1.0), pop_size=8
+    )
+    wf = StdWorkflow(algo, Sphere(), opt_direction="max", migrate_helper=helper)
+    state = run_workflow(wf, 2)
+    assert float(state.algo.pbest_fitness.min()) == -5.0
+
+
+def test_sample_on_fresh_state_uses_init_ask():
+    """Before the first step, sample() must preview init_ask's population
+    (CSO's evaluated batch differs from its pop_size)."""
+    algo = CSO(lb=jnp.full((2,), -1.0), ub=jnp.full((2,), 1.0), pop_size=16)
+    wf = StdWorkflow(algo, Sphere())
+    state = wf.init(jax.random.PRNGKey(0))
+    pop0 = wf.sample(state)  # init_ask path: full population
+    assert pop0.shape == (16, 2)
+    fit0 = wf.validate(state)
+    assert fit0.shape == (16,)
+    stepped = wf.step(state)
+    pop1 = wf.sample(stepped)  # regular ask: CSO proposes half the pop
+    assert pop1.shape == (8, 2)
