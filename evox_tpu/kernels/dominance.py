@@ -142,10 +142,15 @@ def packed_dominance(
             "use_pallas=True but jax.experimental.pallas.tpu is unavailable "
             "in this jax build; pass interpret=True or use the fallback"
         )
-    if tile_i <= 0 or tile_i % 32 != 0:
-        raise ValueError(f"tile_i must be a positive multiple of 32, got {tile_i}")
-    if tile_j <= 0 or tile_j % 128 != 0:
-        raise ValueError(f"tile_j must be a positive multiple of 128, got {tile_j}")
+    if use_pallas:  # the fallback ignores tiling entirely
+        if tile_i <= 0 or tile_i % 32 != 0:
+            raise ValueError(
+                f"tile_i must be a positive multiple of 32, got {tile_i}"
+            )
+        if tile_j <= 0 or tile_j % 128 != 0:
+            raise ValueError(
+                f"tile_j must be a positive multiple of 128, got {tile_j}"
+            )
     n, m = fitness.shape
     n_words = (n + 31) // 32
     if not use_pallas:

@@ -81,9 +81,11 @@ class DatasetProblem(Problem):
         self,
         iterator: Iterator[Any],
         loss_func: Callable,
-        valid_iterator: Optional[Iterator[Any]] = None,
+        valid_iterator: Optional[Any] = None,
         valid_loss_func: Optional[Callable] = None,
     ):
+        # valid_iterator: an iterator of batches, or a zero-arg thunk
+        # returning one (built lazily on the first valid() call)
         self.loss_func = loss_func
         probe = self._coerce(next(iterator))
         self.data_shape_dtypes = _shape_dtypes(probe)
@@ -106,9 +108,9 @@ class DatasetProblem(Problem):
             return batch
         return self._coerce(next(self._iterator))
 
-    def evaluate(self, state, pop):
+    def evaluate(self, state, pop, loss_func: Optional[Callable] = None):
         data = io_callback(self._next_data, self.data_shape_dtypes, ordered=True)
-        loss = jax.vmap(self.loss_func, in_axes=(0, None))(pop, data)
+        loss = jax.vmap(loss_func or self.loss_func, in_axes=(0, None))(pop, data)
         return loss, state
 
     def valid(self, metric: Optional[Callable] = None) -> "Problem":
@@ -126,9 +128,11 @@ class DatasetProblem(Problem):
                 "to use validation mode"
             )
         if self._valid_problem is None:
+            it = self._valid_iterator
+            if callable(it) and not hasattr(it, "__next__"):
+                it = it()  # thunk: loaders built lazily on first valid()
             self._valid_problem = DatasetProblem(
-                self._valid_iterator,
-                self._valid_loss_func or self.loss_func,
+                it, self._valid_loss_func or self.loss_func
             )
         if metric is None:
             return self._valid_problem
@@ -143,10 +147,7 @@ class _MetricView(Problem):
         self.metric = metric
 
     def evaluate(self, state, pop):
-        data = io_callback(
-            self.base._next_data, self.base.data_shape_dtypes, ordered=True
-        )
-        return jax.vmap(self.metric, in_axes=(0, None))(pop, data), state
+        return self.base.evaluate(state, pop, loss_func=self.metric)
 
 
 class TensorflowDataset(DatasetProblem):
@@ -204,8 +205,9 @@ class TensorflowDataset(DatasetProblem):
         super().__init__(
             make_loader(split, seed),
             loss_func,
+            # thunk: the held-out split is only materialized if valid() runs
             valid_iterator=(
-                make_loader(valid_split, seed + 1) if valid_split else None
+                (lambda: make_loader(valid_split, seed + 1)) if valid_split else None
             ),
             valid_loss_func=valid_loss_func,
         )

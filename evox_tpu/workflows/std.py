@@ -207,15 +207,21 @@ class StdWorkflow:
             state = self._run_loop(state, jnp.asarray(n_steps, dtype=jnp.int32))
         return state
 
-    def _ask_preview(self, state: StdWorkflowState) -> Any:
-        """ask() with the same first-step init_ask dispatch as the step."""
-        if state.first_step and (
+    def _dispatch_ask(self, state: StdWorkflowState) -> Tuple[bool, Any, Any]:
+        """First-step-aware ask: ``(use_init, pop, astate)``. The single
+        dispatch point shared by the step and the sample/validate previews,
+        so they can never drift apart."""
+        use_init = state.first_step and (
             self.algorithm.has_init_ask or self.algorithm.has_init_tell
-        ):
-            pop, _ = self.algorithm.init_ask(state.algo)
+        )
+        if use_init:
+            pop, astate = self.algorithm.init_ask(state.algo)
         else:
-            pop, _ = self.algorithm.ask(state.algo)
-        return pop
+            pop, astate = self.algorithm.ask(state.algo)
+        return use_init, pop, astate
+
+    def _ask_preview(self, state: StdWorkflowState) -> Any:
+        return self._dispatch_ask(state)[1]
 
     def sample(self, state: StdWorkflowState) -> Any:
         """The population the algorithm would propose next, without
@@ -328,13 +334,7 @@ class StdWorkflow:
         self._run_hooks("pre_step", mstates)
         self._run_hooks("pre_ask", mstates)
 
-        use_init = state.first_step and (
-            self.algorithm.has_init_ask or self.algorithm.has_init_tell
-        )
-        if use_init:
-            pop, astate = self.algorithm.init_ask(state.algo)
-        else:
-            pop, astate = self.algorithm.ask(state.algo)
+        use_init, pop, astate = self._dispatch_ask(state)
         self._run_hooks("post_ask", mstates, pop)
 
         cand = pop
