@@ -14,15 +14,48 @@ from typing import Any, Optional
 
 import jax
 
+_ASYNC_CKPT = None
 
-def save(state: Any, path: str, backend: str = "orbax") -> None:
-    """Save a state pytree to ``path``."""
-    path = Path(path).resolve()
-    if backend == "orbax":
+
+def _checkpointer():
+    """Process-wide orbax checkpointer (its save path is async-capable)."""
+    global _ASYNC_CKPT
+    if _ASYNC_CKPT is None:
         import orbax.checkpoint as ocp
 
-        with ocp.StandardCheckpointer() as ckpt:
-            ckpt.save(path, state)
+        _ASYNC_CKPT = ocp.StandardCheckpointer()
+    return _ASYNC_CKPT
+
+
+def wait_for_saves() -> None:
+    """Block until every ``save(..., wait=False)`` has committed to disk."""
+    if _ASYNC_CKPT is not None:
+        _ASYNC_CKPT.wait_until_finished()
+
+
+def save(
+    state: Any,
+    path: str,
+    backend: str = "orbax",
+    wait: bool = True,
+    overwrite: bool = False,
+) -> None:
+    """Save a state pytree to ``path``.
+
+    ``wait=False`` (orbax only) returns as soon as the state is staged:
+    serialization and the filesystem commit proceed in orbax's background
+    thread while training continues (SURVEY.md §5.4's async-checkpoint
+    recommendation). Call :func:`wait_for_saves` before reading the
+    checkpoint or exiting the process. An existing destination raises
+    unless ``overwrite=True`` (orbax's guard against clobbering the only
+    good snapshot).
+    """
+    path = Path(path).resolve()
+    if backend == "orbax":
+        ckpt = _checkpointer()
+        ckpt.save(path, state, force=overwrite)
+        if wait:
+            ckpt.wait_until_finished()
     elif backend == "pickle":
         path.parent.mkdir(parents=True, exist_ok=True)
         with open(path, "wb") as f:
@@ -45,8 +78,8 @@ def load(path: str, target: Optional[Any] = None, backend: str = "orbax") -> Any
         if target is None:
             raise ValueError("orbax restore requires a `target` pytree template")
         template = jax.tree.map(ocp.utils.to_shape_dtype_struct, target)
-        with ocp.StandardCheckpointer() as ckpt:
-            return ckpt.restore(path, template)
+        wait_for_saves()  # a pending async save of `path` must land first
+        return _checkpointer().restore(path, template)
     elif backend == "pickle":
         with open(path, "rb") as f:
             return pickle.load(f)

@@ -264,3 +264,19 @@ def test_checkpoint_monitor_adopts_existing_and_validates(tmp_path):
     jax.effects_barrier()
     assert len(mon2.saved) == len(set(mon2.saved)) <= 2
     assert all(p.exists() for p in mon2.saved)
+
+
+def test_async_orbax_save_roundtrip(tmp_path):
+    """save(wait=False) stages and returns; wait_for_saves commits; load
+    restores identically (and itself waits for pending saves)."""
+    from evox_tpu.core import state_io
+
+    state = {
+        "a": jnp.arange(12.0).reshape(3, 4),
+        "b": (jnp.ones((5,)), jnp.zeros((2, 2), dtype=jnp.int32)),
+    }
+    p = tmp_path / "async_ckpt"
+    state_io.save(state, str(p), backend="orbax", wait=False)
+    restored = state_io.load(str(p), target=state, backend="orbax")
+    jax.tree.map(np.testing.assert_allclose, restored, state)
+    state_io.wait_for_saves()  # idempotent after load's implicit wait
