@@ -21,7 +21,7 @@ from .core import (
     POP_AXIS,
 )
 from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
-from .workflows import StdWorkflow
+from .workflows import IslandWorkflow, StdWorkflow
 
 __all__ = [
     "Algorithm",
@@ -34,6 +34,7 @@ __all__ = [
     "create_mesh",
     "POP_AXIS",
     "StdWorkflow",
+    "IslandWorkflow",
     "algorithms",
     "core",
     "monitors",

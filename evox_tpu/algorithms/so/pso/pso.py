@@ -102,3 +102,23 @@ class PSO(Algorithm):
             gbest_fitness=gbest_fitness,
             key=key,
         )
+
+    def migrate(self, state: PSOState, pop: jax.Array, fitness: jax.Array) -> PSOState:
+        """Replace the worst personal bests with the migrants and refresh
+        the global best (PSO keeps no separate evaluated-population fitness,
+        so migration targets the pbest bookkeeping)."""
+        k = fitness.shape[0]
+        worst = jnp.argsort(-state.pbest_fitness)[:k]
+        pbest_fitness = state.pbest_fitness.at[worst].set(fitness)
+        pbest_position = state.pbest_position.at[worst].set(pop)
+        best_i = jnp.argmin(pbest_fitness)
+        improved = pbest_fitness[best_i] <= state.gbest_fitness
+        return state.replace(
+            population=state.population.at[worst].set(pop),
+            pbest_position=pbest_position,
+            pbest_fitness=pbest_fitness,
+            gbest_position=jnp.where(
+                improved, pbest_position[best_i], state.gbest_position
+            ),
+            gbest_fitness=jnp.minimum(state.gbest_fitness, pbest_fitness[best_i]),
+        )

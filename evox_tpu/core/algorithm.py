@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Tuple
 
 import jax
+import jax.numpy as jnp
 
 AlgorithmState = Any
 
@@ -65,3 +66,32 @@ class Algorithm:
     @property
     def has_init_tell(self) -> bool:
         return type(self).init_tell is not Algorithm.init_tell
+
+    # -- optional migration hook --------------------------------------------
+    def migrate(
+        self, state: AlgorithmState, pop: Any, fitness: jax.Array
+    ) -> AlgorithmState:
+        """Ingest foreign individuals (island migration / human-in-the-loop;
+        the slot behind ``StdWorkflow(migrate_helper=...)`` and
+        ``IslandWorkflow`` — reference std_workflow.py:230-244).
+
+        ``fitness`` is in the internal minimization convention. The default
+        replaces the worst rows of ``state.population`` / ``state.fitness``
+        — enough for every population-based single-objective state carrying
+        those two fields; algorithms with extra per-individual bookkeeping
+        (personal bests, archives) or multi-objective selection should
+        override.
+        """
+        pop_arr = getattr(state, "population", None)
+        fit_arr = getattr(state, "fitness", None)
+        if pop_arr is None or fit_arr is None or fit_arr.ndim != 1:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no (population, 1-d fitness) "
+                "state fields; override migrate() to support migration"
+            )
+        k = fitness.shape[0]
+        worst = jnp.argsort(-fit_arr)[:k]
+        return state.replace(
+            population=pop_arr.at[worst].set(pop),
+            fitness=fit_arr.at[worst].set(fitness),
+        )

@@ -1,3 +1,9 @@
 from .std import StdWorkflow, StdWorkflowState
+from .islands import IslandWorkflow, IslandWorkflowState
 
-__all__ = ["StdWorkflow", "StdWorkflowState"]
+__all__ = [
+    "StdWorkflow",
+    "StdWorkflowState",
+    "IslandWorkflow",
+    "IslandWorkflowState",
+]

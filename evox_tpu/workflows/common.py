@@ -1,0 +1,63 @@
+"""Plumbing shared by the workflow implementations."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.problem import Problem
+
+
+def make_run_loop(step_impl: Callable) -> Callable:
+    """Jitted ``(state, n) -> state`` running ``step_impl`` n times in one
+    on-device ``fori_loop``; the trip count is a traced operand, so one
+    compilation covers every ``n``."""
+    return jax.jit(
+        lambda s, n: jax.lax.fori_loop(0, n, lambda _, x: step_impl(x), s)
+    )
+
+
+def fused_run(wf: Any, state: Any, n_steps: int) -> Any:
+    """Shared ``run()`` body: peel the first (init_ask-dispatching)
+    generation eagerly so the loop carry stays type-stable, then hand the
+    rest to ``wf._run_loop`` (or an eager Python loop when
+    ``wf.jit_step=False``)."""
+    if n_steps <= 0:
+        return state
+    if state.first_step:
+        state = wf.step(state)
+        n_steps -= 1
+    if not wf.jit_step:
+        for _ in range(n_steps):
+            state = wf._step_impl(state)
+        return state
+    if n_steps > 0:
+        state = wf._run_loop(state, jnp.asarray(n_steps, dtype=jnp.int32))
+    return state
+
+
+def callback_evaluate(
+    problem: Problem, pstate: Any, cand: Any, num_objectives: int = 1
+) -> Tuple[jax.Array, Any]:
+    """Host-side evaluation through ``jax.pure_callback`` with a declared
+    fitness signature (the reference's ``external_problem=True`` contract,
+    std_workflow.py:146-158). External problems are stateless from the jit
+    program's point of view: the state operand passes through and any host
+    update lives on the problem object itself."""
+    leaves = jax.tree.leaves(cand)
+    pop_size = leaves[0].shape[0]
+    if num_objectives > 1:
+        shape: Tuple[int, ...] = (pop_size, num_objectives)
+    else:
+        shape = problem.fit_shape(pop_size)
+    result_sds = jax.ShapeDtypeStruct(shape, jnp.dtype(problem.fit_dtype))
+
+    def host_eval(ps, c):
+        fit, _ = problem.evaluate(ps, c)
+        return np.asarray(fit, dtype=problem.fit_dtype)
+
+    fitness = jax.pure_callback(host_eval, result_sds, pstate, cand)
+    return fitness, pstate

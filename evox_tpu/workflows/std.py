@@ -28,7 +28,6 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.algorithm import Algorithm
 from ..core.monitor import Monitor
@@ -41,6 +40,7 @@ from ..core.distributed import (
     shard_pop,
 )
 from ..utils.common import parse_opt_direction
+from .common import callback_evaluate, fused_run, make_run_loop
 
 
 class StdWorkflowState(PyTreeNode):
@@ -122,12 +122,13 @@ class StdWorkflow:
         self.external = (not problem.jittable) if external_problem is None else external_problem
         self.eval_shard_map = eval_shard_map
         self.migrate_helper = migrate_helper
-        if migrate_helper is not None and not callable(
-            getattr(algorithm, "migrate", None)
-        ):
+        # migration stores raw (sign-flipped) fitness into the algorithm
+        # state; population-relative shaped fitness cannot coexist with it
+        # (the stored conventions would mix) — see Algorithm.migrate
+        if migrate_helper is not None and fit_transforms:
             raise ValueError(
-                "migrate_helper requires the algorithm to define "
-                "migrate(state, pop, fitness) -> state"
+                "migrate_helper cannot be combined with fit_transforms: "
+                "migrants carry raw fitness while tell stores shaped values"
             )
         if eval_shard_map and (mesh is None or self.external):
             raise ValueError(
@@ -162,9 +163,7 @@ class StdWorkflow:
         self.jit_step = jit_step
         self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
         # dynamic trip count: ONE compile covers every n_steps
-        self._run_loop = jax.jit(
-            lambda s, n: jax.lax.fori_loop(0, n, lambda _, x: self._step_impl(x), s)
-        )
+        self._run_loop = make_run_loop(self._step_impl)
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> StdWorkflowState:
@@ -194,18 +193,7 @@ class StdWorkflow:
         dispatch). With ``jit_step=False`` this falls back to an eager
         Python loop for debugging.
         """
-        if n_steps <= 0:
-            return state
-        if state.first_step:
-            state = self.step(state)
-            n_steps -= 1
-        if not self.jit_step:
-            for _ in range(n_steps):
-                state = self._step_impl(state)
-            return state
-        if n_steps > 0:
-            state = self._run_loop(state, jnp.asarray(n_steps, dtype=jnp.int32))
-        return state
+        return fused_run(self, state, n_steps)
 
     def _dispatch_ask(self, state: StdWorkflowState) -> Tuple[bool, Any, Any]:
         """First-step-aware ask: ``(use_init, pop, astate)``. The single
@@ -272,26 +260,7 @@ class StdWorkflow:
             if self.eval_shard_map:
                 return self._evaluate_shard_map(pstate, cand)
             return self.problem.evaluate(pstate, cand)
-        # Host-side problem via pure_callback with a declared output signature.
-        # The problem state is passed through the callback as an operand (it
-        # would otherwise be a captured tracer); any state *update* stays on
-        # the host object itself — external problems are stateless from the
-        # jit program's point of view, same contract as the reference
-        # (std_workflow.py:146-158).
-        leaves = jax.tree.leaves(cand)
-        pop_size = leaves[0].shape[0]
-        if self.num_objectives > 1:
-            shape = (pop_size, self.num_objectives)
-        else:
-            shape = self.problem.fit_shape(pop_size)
-        result_sds = jax.ShapeDtypeStruct(shape, jnp.dtype(self.problem.fit_dtype))
-
-        def host_eval(ps, c):
-            fit, _ = self.problem.evaluate(ps, c)
-            return np.asarray(fit, dtype=self.problem.fit_dtype)
-
-        fitness = jax.pure_callback(host_eval, result_sds, pstate, cand)
-        return fitness, pstate
+        return callback_evaluate(self.problem, pstate, cand, self.num_objectives)
 
     def _evaluate_shard_map(self, pstate: Any, cand: Any) -> Tuple[jax.Array, Any]:
         """Explicit-collective evaluation: each device scores its local

@@ -1,0 +1,104 @@
+"""IslandWorkflow tests: migration effect, convergence, sharded-mesh
+equivalence, init_ask dispatch, and the Algorithm.migrate defaults."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu import IslandWorkflow, create_mesh
+from evox_tpu.algorithms.so.de import DE
+from evox_tpu.algorithms.so.pso import CSO, PSO
+from evox_tpu.algorithms.so.es import OpenES
+from evox_tpu.problems.numerical import Ackley, Sphere
+
+
+def test_islands_converge_sphere():
+    algo = PSO(lb=jnp.full((4,), -10.0), ub=jnp.full((4,), 10.0), pop_size=24)
+    wf = IslandWorkflow(algo, Sphere(), n_islands=4, migrate_every=5, migrate_k=2)
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.run(state, 60)
+    per_island, best = wf.best(state)
+    assert per_island.shape == (4,)
+    assert float(best) < 1e-2, float(best)
+
+
+def test_migration_spreads_elites():
+    """With migrate_every=1 the best solution reaches every island; with no
+    feasible migration interval the islands stay independent."""
+    algo = DE(lb=jnp.full((6,), -32.0), ub=jnp.full((6,), 32.0), pop_size=20)
+
+    def run(migrate_every):
+        wf = IslandWorkflow(
+            algo, Ackley(), n_islands=6, migrate_every=migrate_every, migrate_k=3
+        )
+        state = wf.init(jax.random.PRNGKey(1))
+        state = wf.run(state, 40)
+        per_island, _ = wf.best(state)
+        return np.asarray(per_island)
+
+    frequent = run(1)
+    rare = run(10**6)  # never migrates within the run
+    # migration pulls every island close to the best one
+    assert frequent.max() - frequent.min() < rare.max() - rare.min()
+    assert frequent.max() < rare.max()
+
+
+def test_islands_sharded_matches_single_device():
+    algo = PSO(lb=jnp.full((3,), -5.0), ub=jnp.full((3,), 5.0), pop_size=16)
+
+    def run(mesh):
+        wf = IslandWorkflow(
+            algo, Sphere(), n_islands=8, migrate_every=3, migrate_k=1, mesh=mesh
+        )
+        state = wf.init(jax.random.PRNGKey(2))
+        state = wf.run(state, 12)
+        return np.asarray(wf.best(state)[0])
+
+    np.testing.assert_allclose(
+        run(create_mesh()), run(None), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_islands_cso_init_ask_path():
+    """CSO's first-generation batch differs from steady state; the island
+    step must dispatch init_ask/init_tell exactly like StdWorkflow."""
+    algo = CSO(lb=jnp.full((3,), -5.0), ub=jnp.full((3,), 5.0), pop_size=16)
+    wf = IslandWorkflow(algo, Sphere(), n_islands=2, migrate_every=4)
+    state = wf.init(jax.random.PRNGKey(3))
+    state = wf.run(state, 30)
+    _, best = wf.best(state)
+    assert float(best) < 1e-2
+
+
+def test_islands_validate_constructor():
+    algo = PSO(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
+    with pytest.raises(ValueError, match="islands"):
+        IslandWorkflow(algo, Sphere(), n_islands=1)
+    with pytest.raises(ValueError, match="divisible"):
+        IslandWorkflow(algo, Sphere(), n_islands=6, mesh=create_mesh())
+    with pytest.raises(ValueError, match="multi-objective"):
+        IslandWorkflow(algo, Sphere(), n_islands=4, num_objectives=2)
+    with pytest.raises(ValueError, match="fit_transforms"):
+        IslandWorkflow(
+            algo, Sphere(), n_islands=4, fit_transforms=(lambda f: f,)
+        )
+
+
+def test_default_migrate_replaces_worst():
+    algo = DE(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
+    state = algo.init(jax.random.PRNGKey(0))
+    state = state.replace(fitness=jnp.arange(8.0))
+    migrants = jnp.full((2, 2), 0.5)
+    new = algo.migrate(state, migrants, jnp.array([-1.0, -2.0]))
+    # worst two rows (fitness 7, 6) replaced
+    assert float(new.fitness.max()) == 5.0
+    assert float(new.fitness.min()) == -2.0
+    np.testing.assert_array_equal(np.asarray(new.population[7]), [0.5, 0.5])
+
+
+def test_migrate_unsupported_state_raises():
+    algo = OpenES(jnp.zeros(3), 8)
+    state = algo.init(jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="migrate"):
+        algo.migrate(state, jnp.zeros((1, 3)), jnp.zeros((1,)))

@@ -283,10 +283,17 @@ def test_migrate_helper_injects_foreign_individuals():
     assert float(jnp.sort(state.algo.pbest_fitness)[3]) == 0.0
 
 
-def test_migrate_helper_requires_migrate_method():
-    algo = PSO(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
-    with pytest.raises(ValueError, match="migrate"):
-        StdWorkflow(algo, Sphere(), migrate_helper=lambda: None)
+def test_migrate_unsupported_algorithm_fails_at_trace():
+    """Algorithms without (population, fitness) state and no migrate
+    override fail when the migration branch is first traced."""
+    from evox_tpu.algorithms.so.es import OpenES
+
+    algo = OpenES(jnp.zeros(2), 8)
+    helper = lambda: (jnp.asarray(False), jnp.zeros((1, 2)), jnp.zeros((1,)))
+    wf = StdWorkflow(algo, Sphere(), migrate_helper=helper)
+    state = wf.init(jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="migrate"):
+        wf.step(state)
 
 
 def test_sample_and_validate():
@@ -351,3 +358,16 @@ def test_sample_on_fresh_state_uses_init_ask():
     stepped = wf.step(state)
     pop1 = wf.sample(stepped)  # regular ask: CSO proposes half the pop
     assert pop1.shape == (8, 2)
+
+
+def test_migrate_helper_rejects_fit_transforms():
+    from evox_tpu.utils import rank_based_fitness
+
+    algo = PSO(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
+    with pytest.raises(ValueError, match="fit_transforms"):
+        StdWorkflow(
+            algo,
+            Sphere(),
+            migrate_helper=lambda: None,
+            fit_transforms=(rank_based_fitness,),
+        )
