@@ -1,6 +1,6 @@
 from .igd import igd, igd_plus, IGD, IGDPlus
 from .gd import gd, gd_plus, GD, GDPlus
-from .hypervolume import hypervolume_mc, HV
+from .hypervolume import hypervolume_2d, hypervolume_mc, HV
 
 __all__ = [
     "igd",
@@ -12,5 +12,6 @@ __all__ = [
     "GD",
     "GDPlus",
     "hypervolume_mc",
+    "hypervolume_2d",
     "HV",
 ]

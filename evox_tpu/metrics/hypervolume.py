@@ -1,11 +1,36 @@
-"""Monte-Carlo hypervolume (reference src/evox/metrics/hypervolume.py:7-96,
-with the same two sampling strategies: one bounding cube, or one cube per
-solution)."""
+"""Hypervolume indicators: Monte-Carlo (reference
+src/evox/metrics/hypervolume.py:7-96, with the same two sampling
+strategies: one bounding cube, or one cube per solution) plus an exact
+2-objective variant the reference lacks — for m=2 the exact sweep is one
+sort, so there is no reason to tolerate MC noise."""
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+def hypervolume_2d(objs: jax.Array, ref: jax.Array) -> jax.Array:
+    """Exact hypervolume for 2 objectives (minimization).
+
+    Sort by the first objective and sum the rectangular slabs between the
+    staircase of non-dominated prefix minima and the reference point —
+    O(n log n), deterministic, jit-safe. Points outside the reference box
+    contribute nothing; dominated points are absorbed by the running
+    minimum.
+    """
+    n, m = objs.shape
+    if m != 2:
+        raise ValueError(f"hypervolume_2d needs 2 objectives, got {m}")
+    order = jnp.argsort(objs[:, 0])
+    f1 = jnp.minimum(objs[order, 0], ref[0])
+    f2 = jnp.minimum(objs[order, 1], ref[1])
+    # staircase: the best (lowest) f2 seen so far dominates this slab
+    f2_min = jax.lax.associative_scan(jnp.minimum, f2)
+    right = jnp.concatenate([f1[1:], ref[:1]])  # slab right edges
+    widths = jnp.maximum(right - f1, 0.0)
+    heights = jnp.maximum(ref[1] - f2_min, 0.0)
+    return jnp.sum(widths * heights)
 
 
 def hypervolume_mc(

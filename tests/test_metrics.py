@@ -47,3 +47,32 @@ def test_hypervolume_each_cube():
         sample_method="each_cube",
     )
     np.testing.assert_allclose(float(hv), 0.3125, atol=0.01)
+
+
+def test_hypervolume_2d_exact():
+    """Exact 2-D HV on a hand-computable staircase, vs brute rectangles,
+    dominated/outside points ignored, and MC agreement."""
+    ref = jnp.array([4.0, 4.0])
+    objs = jnp.array(
+        [
+            [1.0, 3.0],
+            [2.0, 2.0],
+            [3.0, 1.0],
+            [2.5, 2.5],  # dominated by (2, 2)
+            [5.0, 0.5],  # outside ref on f1
+        ]
+    )
+    # staircase area: x in [1,2): h=1; [2,3): h=2; [3,4): h=3 -> 1+2+3 = 6
+    from evox_tpu.metrics import hypervolume_2d, hypervolume_mc
+
+    hv = float(hypervolume_2d(objs, ref))
+    assert abs(hv - 6.0) < 1e-6, hv
+    # permutation invariance
+    perm = jax.random.permutation(jax.random.PRNGKey(0), objs.shape[0])
+    assert abs(float(hypervolume_2d(objs[perm], ref)) - 6.0) < 1e-6
+    # MC agrees within sampling error on a random front
+    key = jax.random.PRNGKey(1)
+    pts = jax.random.uniform(key, (64, 2)) * 3.0
+    exact = float(hypervolume_2d(pts, ref))
+    mc = float(hypervolume_mc(jax.random.PRNGKey(2), pts, ref, num_samples=200_000))
+    assert abs(exact - mc) / exact < 0.02, (exact, mc)
