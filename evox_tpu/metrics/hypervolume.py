@@ -69,6 +69,8 @@ def hypervolume_mc(
 
 
 class HV:
+    """Hypervolume indicator: exact for 2 objectives, Monte-Carlo beyond."""
+
     def __init__(self, ref: jax.Array, num_samples: int = 100_000,
                  sample_method: str = "bounding_cube"):
         self.ref = jnp.asarray(ref)
@@ -76,4 +78,6 @@ class HV:
         self.sample_method = sample_method
 
     def __call__(self, key: jax.Array, objs: jax.Array) -> jax.Array:
+        if self.ref.shape[0] == 2:
+            return hypervolume_2d(objs, self.ref)  # exact; key unused
         return hypervolume_mc(key, objs, self.ref, self.num_samples, self.sample_method)

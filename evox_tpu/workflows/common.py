@@ -2,13 +2,47 @@
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.monitor import HOOK_NAMES, Monitor
 from ..core.problem import Problem
+
+
+def build_hook_table(monitors: Sequence[Monitor]) -> Dict[str, Tuple[int, ...]]:
+    """name -> indices of the monitors implementing that hook."""
+    return {
+        name: tuple(i for i, m in enumerate(monitors) if name in m.hooks())
+        for name in HOOK_NAMES
+    }
+
+
+def run_hooks(
+    monitors: Sequence[Monitor],
+    table: Dict[str, Tuple[int, ...]],
+    name: str,
+    mstates: list,
+    *args: Any,
+) -> None:
+    """Dispatch one hook across monitors, updating ``mstates`` in place."""
+    for i in table[name]:
+        mstates[i] = getattr(monitors[i], name)(mstates[i], *args)
+
+
+def finish_step(
+    monitors: Sequence[Monitor],
+    table: Dict[str, Tuple[int, ...]],
+    new_state: Any,
+) -> Any:
+    """Run the ``post_step`` hooks against the otherwise-final workflow
+    state (so monitors observe exactly what the step returns), then fold
+    their updated states back in."""
+    mstates = list(new_state.monitors)
+    run_hooks(monitors, table, "post_step", mstates, new_state)
+    return new_state.replace(monitors=tuple(mstates))
 
 
 def make_run_loop(step_impl: Callable) -> Callable:

@@ -37,16 +37,25 @@ import jax.numpy as jnp
 
 from ..core.algorithm import Algorithm
 from ..core.distributed import POP_AXIS as _POP_AXIS_NAME, shard_pop
+from ..core.monitor import Monitor
 from ..core.problem import Problem
 from ..core.struct import PyTreeNode, static_field
 from ..utils.common import parse_opt_direction
-from .common import callback_evaluate, fused_run, make_run_loop
+from .common import (
+    build_hook_table,
+    callback_evaluate,
+    finish_step,
+    fused_run,
+    make_run_loop,
+    run_hooks,
+)
 
 
 class IslandWorkflowState(PyTreeNode):
     generation: jax.Array
     algo: Any  # island-stacked algorithm state (leading axis = island)
     prob: Any
+    monitors: Tuple[Any, ...] = ()
     first_step: bool = static_field(default=True)
 
 
@@ -63,6 +72,8 @@ class IslandWorkflow:
         n_islands: number of islands.
         migrate_every: generations between migrations.
         migrate_k: individuals sent per island per migration.
+        monitors: 8-hook monitors, as :class:`StdWorkflow`; hooks see the
+            flattened ``(islands * pop, ...)`` candidate batch.
         opt_direction / pop_transforms: as :class:`StdWorkflow`; transforms
             see the flattened ``(islands * pop, ...)`` batch.
             ``fit_transforms`` is rejected — population-relative shaping
@@ -82,6 +93,7 @@ class IslandWorkflow:
         n_islands: int,
         migrate_every: int = 10,
         migrate_k: int = 1,
+        monitors: Sequence[Monitor] = (),
         opt_direction: Any = "min",
         pop_transforms: Sequence[Callable] = (),
         fit_transforms: Sequence[Callable] = (),
@@ -112,7 +124,11 @@ class IslandWorkflow:
         self.n_islands = n_islands
         self.migrate_every = migrate_every
         self.migrate_k = migrate_k
+        self.monitors = tuple(monitors)
         self.opt_direction = parse_opt_direction(opt_direction)
+        for m in self.monitors:
+            m.set_opt_direction(self.opt_direction)
+        self._hook_table = build_hook_table(self.monitors)
         self.pop_transforms = tuple(pop_transforms)
         self.mesh = mesh
         self.external = (not problem.jittable) if external_problem is None else external_problem
@@ -129,14 +145,15 @@ class IslandWorkflow:
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> IslandWorkflowState:
-        k_prob, k_islands = jax.random.split(key)
-        island_keys = jax.random.split(k_islands, self.n_islands)
+        keys = jax.random.split(key, 2 + len(self.monitors))
+        island_keys = jax.random.split(keys[1], self.n_islands)
         algo = jax.vmap(self.algorithm.init)(island_keys)
         algo = self._constrain(algo)
         return IslandWorkflowState(
             generation=jnp.zeros((), dtype=jnp.int32),
             algo=algo,
-            prob=self.problem.init(k_prob),
+            prob=self.problem.init(keys[0]),
+            monitors=tuple(m.init(k) for m, k in zip(self.monitors, keys[2:])),
             first_step=True,
         )
 
@@ -201,6 +218,10 @@ class IslandWorkflow:
         return jax.vmap(self.algorithm.migrate)(astate, recv, recv_fit)
 
     def _step_impl(self, state: IslandWorkflowState) -> IslandWorkflowState:
+        mstates = list(state.monitors)
+        run_hooks(self.monitors, self._hook_table, "pre_step", mstates)
+        run_hooks(self.monitors, self._hook_table, "pre_ask", mstates)
+
         use_init = state.first_step and (
             self.algorithm.has_init_ask or self.algorithm.has_init_tell
         )
@@ -211,11 +232,18 @@ class IslandWorkflow:
         cand_flat = jax.tree.map(
             lambda x: x.reshape((self.n_islands * batch,) + x.shape[2:]), pop
         )
+        run_hooks(self.monitors, self._hook_table, "post_ask", mstates, cand_flat)
         for t in self.pop_transforms:
             cand_flat = t(cand_flat)
         cand_flat = shard_pop(cand_flat, self.mesh)
 
+        run_hooks(self.monitors, self._hook_table, "pre_eval", mstates, cand_flat)
         raw_fitness, pstate = self._evaluate(state.prob, cand_flat)
+        # monitors see the flattened (islands * B) batch in the user's
+        # fitness convention, exactly like StdWorkflow
+        run_hooks(
+            self.monitors, self._hook_table, "post_eval", mstates, cand_flat, raw_fitness
+        )
         # internal minimization convention, shared by tell and migration
         # (the constructor rejects fit_transforms: shaped fitness is
         # population-relative and would poison the migrants' stored values)
@@ -223,8 +251,12 @@ class IslandWorkflow:
             self.n_islands, batch
         )
 
+        run_hooks(
+            self.monitors, self._hook_table, "pre_tell", mstates, fitness.reshape(-1)
+        )
         tell = self.algorithm.init_tell if use_init else self.algorithm.tell
         astate = jax.vmap(tell)(astate, fitness)
+        run_hooks(self.monitors, self._hook_table, "post_tell", mstates)
 
         gen = state.generation + 1
         astate = jax.lax.cond(
@@ -234,6 +266,11 @@ class IslandWorkflow:
             astate,
         )
         astate = self._constrain(astate)
-        return state.replace(
-            generation=gen, algo=astate, prob=pstate, first_step=False
+        new_state = state.replace(
+            generation=gen,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(mstates),
+            first_step=False,
         )
+        return finish_step(self.monitors, self._hook_table, new_state)

@@ -40,7 +40,14 @@ from ..core.distributed import (
     shard_pop,
 )
 from ..utils.common import parse_opt_direction
-from .common import callback_evaluate, fused_run, make_run_loop
+from .common import (
+    build_hook_table,
+    callback_evaluate,
+    finish_step,
+    fused_run,
+    make_run_loop,
+    run_hooks,
+)
 
 
 class StdWorkflowState(PyTreeNode):
@@ -147,19 +154,7 @@ class StdWorkflow:
                     )
         for m in self.monitors:
             m.set_opt_direction(self.opt_direction)
-        self._hook_table = {
-            name: tuple(i for i, m in enumerate(self.monitors) if name in m.hooks())
-            for name in (
-                "pre_step",
-                "pre_ask",
-                "post_ask",
-                "pre_eval",
-                "post_eval",
-                "pre_tell",
-                "post_tell",
-                "post_step",
-            )
-        }
+        self._hook_table = build_hook_table(self.monitors)
         self.jit_step = jit_step
         self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
         # dynamic trip count: ONE compile covers every n_steps
@@ -247,8 +242,7 @@ class StdWorkflow:
         return fitness
 
     def _run_hooks(self, name: str, mstates: list, *args: Any) -> None:
-        for i in self._hook_table[name]:
-            mstates[i] = getattr(self.monitors[i], name)(mstates[i], *args)
+        run_hooks(self.monitors, self._hook_table, name, mstates, *args)
 
     def _flip(self, fitness: jax.Array) -> jax.Array:
         if fitness.ndim == 1:
@@ -351,6 +345,4 @@ class StdWorkflow:
             monitors=tuple(mstates),
             first_step=False,
         )
-        mstates = list(new_state.monitors)
-        self._run_hooks("post_step", mstates, new_state)
-        return new_state.replace(monitors=tuple(mstates))
+        return finish_step(self.monitors, self._hook_table, new_state)

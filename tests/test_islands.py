@@ -102,3 +102,23 @@ def test_migrate_unsupported_state_raises():
     state = algo.init(jax.random.PRNGKey(0))
     with pytest.raises(NotImplementedError, match="migrate"):
         algo.migrate(state, jnp.zeros((1, 3)), jnp.zeros((1,)))
+
+
+def test_islands_with_eval_monitor():
+    """Monitors observe the flattened cross-island batch: the monitor's
+    best matches the best island."""
+    from evox_tpu.monitors import EvalMonitor
+
+    algo = DE(lb=jnp.full((4,), -10.0), ub=jnp.full((4,), 10.0), pop_size=16)
+    mon = EvalMonitor(topk=3)
+    wf = IslandWorkflow(
+        algo, Sphere(), n_islands=4, migrate_every=5, monitors=(mon,)
+    )
+    state = wf.init(jax.random.PRNGKey(4))
+    state = wf.run(state, 40)
+    best_mon = float(mon.get_best_fitness(state.monitors[0]))
+    _, best_island = wf.best(state)
+    assert best_mon <= float(best_island) + 1e-6
+    assert best_mon < 1e-2
+    topk = mon.get_topk_fitness(state.monitors[0])
+    assert topk.shape == (3,)

@@ -76,3 +76,15 @@ def test_hypervolume_2d_exact():
     exact = float(hypervolume_2d(pts, ref))
     mc = float(hypervolume_mc(jax.random.PRNGKey(2), pts, ref, num_samples=200_000))
     assert abs(exact - mc) / exact < 0.02, (exact, mc)
+
+
+def test_hv_class_dispatches_exact_for_2d():
+    from evox_tpu.metrics import HV, hypervolume_2d
+
+    pts = jax.random.uniform(jax.random.PRNGKey(3), (32, 2)) * 3.0
+    ref = jnp.array([4.0, 4.0])
+    hv = HV(ref=ref)
+    # exact path: result is deterministic and equals hypervolume_2d
+    a = float(hv(jax.random.PRNGKey(0), pts))
+    b = float(hv(jax.random.PRNGKey(99), pts))
+    assert a == b == float(hypervolume_2d(pts, ref))
