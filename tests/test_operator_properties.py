@@ -1,0 +1,138 @@
+"""Property tests for variation/selection operators: bounds preservation,
+membership, tournament winner optimality, determinism — invariants the
+golden-value tests don't pin down."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.operators.crossover.sbx import simulated_binary
+from evox_tpu.operators.crossover.simple import one_point, uniform_rand_cross
+from evox_tpu.operators.mutation.ops import bitflip, gaussian, polynomial
+from evox_tpu.operators.selection.basic import (
+    roulette_wheel,
+    tournament,
+    tournament_multifit,
+    uniform_rand,
+)
+
+KEYS = [jax.random.PRNGKey(s) for s in range(3)]
+
+
+def _pop(key, n=32, d=7, lo=-2.0, hi=3.0):
+    return jax.random.uniform(key, (n, d), minval=lo, maxval=hi)
+
+
+@pytest.mark.parametrize("key", KEYS, ids=lambda k: str(int(k[1])))
+def test_polynomial_mutation_respects_bounds(key):
+    lb, ub = -jnp.ones(7) * 2.0, jnp.full((7,), 3.0)
+    pop = _pop(key)
+    out = polynomial(key, pop, (lb, ub), pro_m=7.0)  # every gene mutates
+    assert out.shape == pop.shape
+    assert bool((out >= lb).all() and (out <= ub).all())
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_polynomial_mutation_degenerate_span():
+    """lb == ub genes must stay fixed, not NaN (0/0 in the normalization)."""
+    lb = jnp.array([0.0, 1.0, -1.0])
+    ub = jnp.array([0.0, 2.0, -1.0])  # genes 0 and 2 have zero span
+    pop = jnp.broadcast_to(jnp.array([0.0, 1.5, -1.0]), (16, 3))
+    out = polynomial(jax.random.PRNGKey(0), pop, (lb, ub), pro_m=3.0)
+    assert bool(jnp.isfinite(out).all())
+    np.testing.assert_array_equal(np.asarray(out[:, 0]), 0.0)
+    np.testing.assert_array_equal(np.asarray(out[:, 2]), -1.0)
+
+
+@pytest.mark.parametrize("key", KEYS, ids=lambda k: str(int(k[1])))
+def test_sbx_children_within_parent_bounds_distribution(key):
+    pop = _pop(key, n=64)
+    out = simulated_binary(key, pop)
+    assert out.shape == pop.shape
+    assert bool(jnp.isfinite(out).all())
+    # SBX children stay near parents: contracted around parent pairs, the
+    # population mean per gene is preserved in expectation — loose check
+    assert float(jnp.abs(out.mean() - pop.mean())) < 0.5
+
+
+def test_crossover_gene_membership():
+    """one_point / uniform crossover only exchange genes between the pair —
+    every child gene equals one of its two parents' genes."""
+    pop = _pop(jax.random.PRNGKey(1), n=16, d=9)
+    for op in (one_point, uniform_rand_cross):
+        out = op(jax.random.PRNGKey(2), pop)
+        a = np.asarray(pop).reshape(8, 2, 9)
+        c = np.asarray(out).reshape(8, 2, 9)
+        for p in range(8):
+            for child in range(2):
+                match = (c[p, child] == a[p, 0]) | (c[p, child] == a[p, 1])
+                assert match.all(), (op.__name__, p, child)
+
+
+def test_bitflip_only_flips():
+    pop = (jax.random.uniform(jax.random.PRNGKey(3), (32, 10)) > 0.5).astype(jnp.int32)
+    out = bitflip(jax.random.PRNGKey(4), pop, prob=0.5)
+    vals = np.unique(np.asarray(out))
+    assert set(vals.tolist()) <= {0, 1}
+    boolpop = pop.astype(bool)
+    outb = bitflip(jax.random.PRNGKey(5), boolpop, prob=1.0)
+    np.testing.assert_array_equal(np.asarray(outb), ~np.asarray(boolpop))
+
+
+def test_gaussian_mutation_distribution():
+    pop = jnp.zeros((4096, 4))
+    out = gaussian(jax.random.PRNGKey(6), pop, stdvar=0.5)
+    assert abs(float(out.mean())) < 0.02
+    assert abs(float(out.std()) - 0.5) < 0.02
+
+
+def test_tournament_winners_beat_random():
+    """Selected individuals have stochastically better fitness than the
+    population average, and every winner is a population member."""
+    key = jax.random.PRNGKey(7)
+    pop = _pop(key, n=64, d=3)
+    fitness = jnp.sum(pop**2, axis=1)
+    sel = tournament(key, pop, fitness, tournament_size=4)
+    sel_fit = jnp.sum(sel**2, axis=1)
+    assert float(sel_fit.mean()) < float(fitness.mean())
+    pop_np = np.asarray(pop)
+    for row in np.asarray(sel):
+        assert (pop_np == row).all(axis=1).any()
+
+
+def test_tournament_multifit_lexicographic():
+    """First key ties everywhere, second key decides: selected individuals
+    must be biased toward low second-key fitness (contestants are drawn
+    with replacement, so the global optimum need not appear every round —
+    the check is distributional plus a tie-break sanity run)."""
+    pop = jnp.arange(8.0)[:, None]
+    fits = jnp.stack([jnp.zeros(8), jnp.arange(8.0)[::-1]], axis=1)
+    sel = tournament_multifit(
+        jax.random.PRNGKey(8), pop, fits, tournament_size=6, n_round=256
+    )
+    # second key favors high indices (reversed arange): mean well above 3.5
+    assert float(sel.mean()) > 5.0
+    # distinct first keys dominate the ordering: index 0 (first key min)
+    fits2 = jnp.stack([jnp.arange(8.0), jnp.full((8,), 9.0)], axis=1)
+    sel2 = tournament_multifit(
+        jax.random.PRNGKey(9), pop, fits2, tournament_size=6, n_round=256
+    )
+    assert float(sel2.mean()) < 2.5
+
+
+def test_roulette_prefers_low_fitness():
+    pop = jnp.arange(16.0)[:, None]
+    fitness = jnp.arange(16.0)  # individual 0 is best (min convention)
+    sel = roulette_wheel(jax.random.PRNGKey(9), pop, fitness, n=4096)
+    # better-than-average individuals are over-represented
+    assert float(sel.mean()) < 7.5
+
+
+def test_uniform_rand_membership_and_shape():
+    pop = _pop(jax.random.PRNGKey(10), n=20, d=5)
+    sel = uniform_rand(jax.random.PRNGKey(11), pop, 50)
+    assert sel.shape == (50, 5)
+    pop_np = np.asarray(pop)
+    for row in np.asarray(sel):
+        assert (pop_np == row).all(axis=1).any()
