@@ -1,0 +1,198 @@
+"""Fused policy-rollout kernel (Pallas TPU): the whole episode in VMEM.
+
+The scan-based rollout (problems/neuroevolution/rollout.py) is bound not
+by FLOPs but by fusion boundaries: each of the T environment steps
+round-trips the carry (env state, observations, hidden activations)
+through HBM, so at pendulum scale the chip runs at a few percent of VPU
+peak. This kernel runs the ENTIRE episode for a tile of environments
+inside one Pallas program — policy weights, env state and activations
+stay resident in VMEM across all T steps; HBM sees one theta read and one
+fitness write per environment, total.
+
+Scope: the MLP policy from ``mlp_policy``-style flat genomes and envs
+expressed in SoA form over component arrays. ``pendulum_step_soa`` ships
+as the built-in instance (the bench workload); other never-terminating
+classic-control envs fit the same mold. The generic while_loop rollout
+remains the default engine — this kernel is the opt-in fast path for the
+fixed-horizon case (``PolicyRolloutProblem(early_exit=False)`` shapes).
+
+CPU interpret-mode tests pin the kernel to the scan rollout's numerics;
+measured v5e numbers live at the bottom of this docstring's companion,
+docs/PERF_NOTES.md §8.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+# environments in SoA form: state is a dict of per-env component arrays
+SoAState = Dict[str, jax.Array]
+
+_LANES = 128  # TPU vreg lane width
+
+
+def pendulum_reset_soa(key: jax.Array, n: int) -> SoAState:
+    """Matches control/envs.pendulum reset ranges (batched)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "th": jax.random.uniform(k1, (n,), minval=-jnp.pi, maxval=jnp.pi),
+        "thdot": jax.random.uniform(k2, (n,), minval=-1.0, maxval=1.0),
+    }
+
+
+def pendulum_obs_soa(s: SoAState) -> Tuple[jax.Array, ...]:
+    return (jnp.cos(s["th"]), jnp.sin(s["th"]), s["thdot"])
+
+
+def pendulum_step_soa(s: SoAState, u: jax.Array) -> Tuple[SoAState, jax.Array]:
+    """One step on (tile,) component arrays; identical math to
+    control/envs.pendulum (envs.py:76-101)."""
+    max_speed, max_torque, dt, g = 8.0, 2.0, 0.05, 10.0
+    th, thdot = s["th"], s["thdot"]
+    u = jnp.clip(u, -max_torque, max_torque)
+    norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+    cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+    thdot = thdot + (3.0 * g / 2.0 * jnp.sin(th) + 3.0 * u) * dt
+    thdot = jnp.clip(thdot, -max_speed, max_speed)
+    return {"th": th + thdot * dt, "thdot": thdot}, -cost
+
+
+def _mlp_act(theta_ref, obs: Tuple[jax.Array, ...], obs_dim: int, hidden: int):
+    """(tile,) action from per-env flat genomes resident in VMEM.
+
+    ``theta_ref`` is the TRANSPOSED genome tile ``(dim, tile)``: each
+    genome component is one sublane row, so every access below is a
+    full-lane ``(tile,)`` VPU vector — static loops over the (small)
+    obs/hidden indices, no in-kernel reshapes or lane gathers.
+    """
+    n1 = obs_dim * hidden
+    n2 = n1 + hidden
+    n3 = n2 + hidden  # act_dim = 1
+    h = [theta_ref[n1 + j] for j in range(hidden)]  # start from b1
+    for k in range(obs_dim):
+        for j in range(hidden):
+            h[j] = h[j] + obs[k] * theta_ref[k * hidden + j]
+    a = theta_ref[n3]  # b2
+    for j in range(hidden):
+        a = a + jnp.tanh(h[j]) * theta_ref[n2 + j]
+    return a
+
+
+def _rollout_kernel(
+    theta_ref,
+    state_refs,
+    out_ref,
+    *,
+    T: int,
+    obs_dim: int,
+    hidden: int,
+    step_soa: Callable,
+    obs_soa: Callable,
+    state_keys: Tuple[str, ...],
+):
+    state = {k: r[:] for k, r in zip(state_keys, state_refs)}
+    total0 = jnp.zeros_like(state[state_keys[0]])
+
+    def body(_, carry):
+        state, total = carry
+        obs = obs_soa(state)
+        a = _mlp_act(theta_ref, obs, obs_dim, hidden)
+        state, reward = step_soa(state, a)
+        return state, total + reward
+
+    _, total = jax.lax.fori_loop(0, T, body, (state, total0))
+    out_ref[:] = total
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "T", "obs_dim", "hidden", "step_soa", "obs_soa", "tile", "interpret"
+    ),
+)
+def fused_rollout(
+    theta: jax.Array,
+    init_state: SoAState,
+    T: int,
+    obs_dim: int = 3,
+    hidden: int = 16,
+    step_soa: Callable = pendulum_step_soa,
+    obs_soa: Callable = pendulum_obs_soa,
+    tile: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Total episode reward per environment, fully fused.
+
+    Args:
+        theta: ``(n_envs, dim)`` flat MLP genomes (one row per env; repeat
+            rows for multiple episodes per individual).
+        init_state: SoA env state dict of ``(n_envs,)`` arrays.
+        T: fixed episode length.
+        obs_dim / hidden: MLP shape (act_dim is 1).
+        step_soa / obs_soa: the env's SoA step/observation functions (any
+            jax-traceable elementwise math over the component arrays).
+        tile: environments per Pallas grid cell; theta tile must fit VMEM
+            (tile x dim x 4 bytes, default 1024 x 81 ≈ 330 KB).
+    """
+    if not (_HAS_PLTPU or interpret):
+        raise RuntimeError(
+            "fused_rollout needs pallas TPU support (or interpret=True)"
+        )
+    if tile % (8 * _LANES) != 0:
+        raise ValueError(f"tile must be a multiple of {8 * _LANES}, got {tile}")
+    n, dim = theta.shape
+    pad = (-n) % tile
+    if pad:
+        theta = jnp.pad(theta, ((0, pad), (0, 0)))
+        init_state = {k: jnp.pad(v, (0, pad)) for k, v in init_state.items()}
+    n_pad = n + pad
+    # every per-env quantity becomes a full (sublane, lane) = (8k, 128m)
+    # tile: genome components are (rows, LANES) planes of a 3-D theta
+    # block, env state components are matching 2-D tiles — all kernel ops
+    # are full-width VPU instructions (1-D (tile,) values waste 7/8
+    # sublanes and measured ~5x slower)
+    rows_total = n_pad // _LANES
+    rows_tile = tile // _LANES
+    theta_t = theta.T.reshape(dim, rows_total, _LANES)
+    state_2d = {
+        k: v.reshape(rows_total, _LANES) for k, v in sorted(init_state.items())
+    }
+    state_keys = tuple(state_2d)
+    kernel = functools.partial(
+        _rollout_kernel,
+        T=T,
+        obs_dim=obs_dim,
+        hidden=hidden,
+        step_soa=step_soa,
+        obs_soa=obs_soa,
+        state_keys=state_keys,
+    )
+
+    def wrapped(theta_ref, *state_refs_and_out):
+        kernel(theta_ref, state_refs_and_out[:-1], state_refs_and_out[-1])
+
+    total = pl.pallas_call(
+        wrapped,
+        grid=(rows_total // rows_tile,),
+        in_specs=[pl.BlockSpec((dim, rows_tile, _LANES), lambda i: (0, i, 0))]
+        + [
+            pl.BlockSpec((rows_tile, _LANES), lambda i: (i, 0))
+            for _ in state_keys
+        ],
+        out_specs=pl.BlockSpec((rows_tile, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_total, _LANES), theta.dtype),
+        interpret=interpret,
+    )(theta_t, *state_2d.values())
+    return total.reshape(n_pad)[:n]
