@@ -119,44 +119,32 @@ def bench_cso_ref():
 # OpenES + on-device policy rollouts, pop=65536 (north-star shape). The
 # policy is a flat-genome MLP (3 -> 16 -> 1) so both frameworks consume the
 # identical (pop, dim) population with zero transform overhead differences.
+# Ours runs the fused Pallas episode kernel (kernels/rollout.py: the whole
+# episode resident in VMEM, numerics-pinned to the scan engine by
+# tests/test_kernels.py); the reference runs its own engine shape — the
+# double-vmap ``lax.while_loop`` of reference brax.py:62-97.
 
 RO_POP, RO_STEPS, RO_EPISODES = 65536, 10, 2
 RO_HIDDEN = 16
 
 
-def _flat_mlp(obs_dim: int, act_dim: int, hidden: int):
-    """Flat-vector MLP policy shared verbatim by both benchmark sides.
+def _rollout_problem(fused: bool, **kwargs):
+    from evox_tpu.kernels.rollout import pendulum_soa
+    from evox_tpu.problems.neuroevolution import (
+        PolicyRolloutProblem,
+        flat_mlp_policy,
+    )
 
-    Broadcast-multiply-reduce instead of ``@``: under the per-individual
-    vmap a tiny batched matmul gets padded onto the MXU at ~6x cost
-    (428k -> 2712k evals/sec at pop=65536; see
-    evox_tpu/problems/neuroevolution/policy.py). Shared by both sides so
-    the ratio keeps isolating framework machinery, not policy math.
-    """
-    n1 = obs_dim * hidden
-    n2 = n1 + hidden
-    n3 = n2 + hidden * act_dim
-    dim = n3 + act_dim
-
-    def apply(theta, obs):
-        w1 = theta[:n1].reshape(obs_dim, hidden)
-        b1 = theta[n1:n2]
-        w2 = theta[n2:n3].reshape(hidden, act_dim)
-        b2 = theta[n3:]
-        h = jnp.tanh(jnp.sum(obs[..., :, None] * w1, axis=-2) + b1)
-        return jnp.sum(h[..., :, None] * w2, axis=-2) + b2
-
-    return apply, dim
-
-
-def _rollout_problem(**kwargs):
-    from evox_tpu.problems.neuroevolution import PolicyRolloutProblem
-    from evox_tpu.problems.neuroevolution.control import pendulum
-
-    env = pendulum(max_steps=200)
-    apply, dim = _flat_mlp(env.obs_dim, env.act_dim, RO_HIDDEN)
+    soa = pendulum_soa(max_steps=200)
+    env = soa.base
+    apply, dim = flat_mlp_policy(env.obs_dim, RO_HIDDEN, env.act_dim)
     prob = PolicyRolloutProblem(
-        apply, env, num_episodes=RO_EPISODES, stochastic_reset=False, **kwargs
+        apply,
+        env,
+        num_episodes=RO_EPISODES,
+        stochastic_reset=False,
+        fused_env=soa if fused else None,
+        **kwargs,
     )
     return prob, dim
 
@@ -165,12 +153,7 @@ def bench_rollout_ours():
     from evox_tpu import StdWorkflow
     from evox_tpu.algorithms.so.es import OpenES
 
-    # pendulum never terminates early -> the unrolled-scan rollout path
-    # (early_exit=False) removes per-iteration while_loop overhead; the
-    # reference has no such mode, its while_loop shape is the baseline.
-    # unroll 4 and 8 measure equal (~2.9M evals/sec) with the VPU-friendly
-    # policy, both ahead of 1-2 (~2.6M)
-    prob, dim = _rollout_problem(early_exit=False, unroll=4)
+    prob, dim = _rollout_problem(fused=True, early_exit=False)
     algo = OpenES(jnp.zeros(dim), RO_POP, learning_rate=0.05, noise_stdev=0.05)
     wf = StdWorkflow(algo, prob, opt_direction="max")
     state = wf.init(jax.random.PRNGKey(0))
@@ -180,7 +163,7 @@ def bench_rollout_ours():
 def bench_rollout_ref():
     from evox import Problem, State, algorithms as ralg, workflows as rwf
 
-    prob, dim = _rollout_problem()
+    prob, dim = _rollout_problem(fused=False)
     rollout_state = prob.init(jax.random.PRNGKey(7))
 
     class RefRollout(Problem):

@@ -10,5 +10,12 @@ fallback stays the default.
 """
 
 from .dominance import packed_dominance, packed_dominance_reference
+from .rollout import SoAEnv, fused_rollout, pendulum_soa
 
-__all__ = ["packed_dominance", "packed_dominance_reference"]
+__all__ = [
+    "packed_dominance",
+    "packed_dominance_reference",
+    "SoAEnv",
+    "fused_rollout",
+    "pendulum_soa",
+]

@@ -16,15 +16,16 @@ classic-control envs fit the same mold. The generic while_loop rollout
 remains the default engine — this kernel is the opt-in fast path for the
 fixed-horizon case (``PolicyRolloutProblem(early_exit=False)`` shapes).
 
-CPU interpret-mode tests pin the kernel to the scan rollout's numerics;
-measured v5e numbers live at the bottom of this docstring's companion,
-docs/PERF_NOTES.md §8.
+CPU interpret-mode tests (tests/test_kernels.py) pin the kernel to the
+scan rollout's numerics; measured v5e numbers live in docs/PERF_NOTES.md
+§8. The wiring into :class:`PolicyRolloutProblem` (the ``fused_env=``
+constructor parameter) lives in problems/neuroevolution/rollout.py.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,20 @@ SoAState = Dict[str, jax.Array]
 _LANES = 128  # TPU vreg lane width
 
 
+class SoAEnv(NamedTuple):
+    """An :class:`~...control.envs.EnvSpec` re-expressed over SoA component
+    planes, for the fused kernel. ``base`` keeps the AoS spec (used for
+    reset — so the fused path draws the *same* initial states as the scan
+    path and the numerics-pinning tests can compare them directly);
+    ``to_soa`` converts a batched AoS state ``(n, ...)`` into the dict of
+    ``(n,)`` component arrays that ``step_soa``/``obs_soa`` operate on."""
+
+    base: Any  # EnvSpec
+    to_soa: Callable[[Any], SoAState]
+    obs_soa: Callable[[SoAState], Tuple[jax.Array, ...]]
+    step_soa: Callable[[SoAState, Tuple[jax.Array, ...]], Tuple[SoAState, jax.Array]]
+
+
 def pendulum_reset_soa(key: jax.Array, n: int) -> SoAState:
     """Matches control/envs.pendulum reset ranges (batched)."""
     k1, k2 = jax.random.split(key)
@@ -56,12 +71,14 @@ def pendulum_obs_soa(s: SoAState) -> Tuple[jax.Array, ...]:
     return (jnp.cos(s["th"]), jnp.sin(s["th"]), s["thdot"])
 
 
-def pendulum_step_soa(s: SoAState, u: jax.Array) -> Tuple[SoAState, jax.Array]:
+def pendulum_step_soa(
+    s: SoAState, a: Tuple[jax.Array, ...]
+) -> Tuple[SoAState, jax.Array]:
     """One step on (tile,) component arrays; identical math to
     control/envs.pendulum (envs.py:76-101)."""
     max_speed, max_torque, dt, g = 8.0, 2.0, 0.05, 10.0
     th, thdot = s["th"], s["thdot"]
-    u = jnp.clip(u, -max_torque, max_torque)
+    u = jnp.clip(a[0], -max_torque, max_torque)
     norm_th = ((th + jnp.pi) % (2 * jnp.pi)) - jnp.pi
     cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
     thdot = thdot + (3.0 * g / 2.0 * jnp.sin(th) + 3.0 * u) * dt
@@ -69,25 +86,49 @@ def pendulum_step_soa(s: SoAState, u: jax.Array) -> Tuple[SoAState, jax.Array]:
     return {"th": th + thdot * dt, "thdot": thdot}, -cost
 
 
-def _mlp_act(theta_ref, obs: Tuple[jax.Array, ...], obs_dim: int, hidden: int):
-    """(tile,) action from per-env flat genomes resident in VMEM.
+def pendulum_soa(max_steps: int = 200) -> SoAEnv:
+    """The built-in :class:`SoAEnv` instance (bench workload 2's env)."""
+    from ..problems.neuroevolution.control.envs import pendulum
+
+    return SoAEnv(
+        base=pendulum(max_steps=max_steps),
+        to_soa=lambda s: {"th": s[..., 0], "thdot": s[..., 1]},
+        obs_soa=pendulum_obs_soa,
+        step_soa=pendulum_step_soa,
+    )
+
+
+def _mlp_act(
+    theta_ref,
+    obs: Tuple[jax.Array, ...],
+    obs_dim: int,
+    hidden: int,
+    act_dim: int,
+) -> Tuple[jax.Array, ...]:
+    """(tile,) actions from per-env flat genomes resident in VMEM.
 
     ``theta_ref`` is the TRANSPOSED genome tile ``(dim, tile)``: each
     genome component is one sublane row, so every access below is a
     full-lane ``(tile,)`` VPU vector — static loops over the (small)
-    obs/hidden indices, no in-kernel reshapes or lane gathers.
+    obs/hidden indices, no in-kernel reshapes or lane gathers. Genome
+    layout matches ``flat_mlp_policy`` (policy.py): w1 row-major, b1,
+    w2 row-major, b2.
     """
     n1 = obs_dim * hidden
     n2 = n1 + hidden
-    n3 = n2 + hidden  # act_dim = 1
+    n3 = n2 + hidden * act_dim
     h = [theta_ref[n1 + j] for j in range(hidden)]  # start from b1
     for k in range(obs_dim):
         for j in range(hidden):
             h[j] = h[j] + obs[k] * theta_ref[k * hidden + j]
-    a = theta_ref[n3]  # b2
-    for j in range(hidden):
-        a = a + jnp.tanh(h[j]) * theta_ref[n2 + j]
-    return a
+    th = [jnp.tanh(hj) for hj in h]
+    acts = []
+    for i in range(act_dim):
+        a = theta_ref[n3 + i]  # b2[i]
+        for j in range(hidden):
+            a = a + th[j] * theta_ref[n2 + j * act_dim + i]
+        acts.append(a)
+    return tuple(acts)
 
 
 def _rollout_kernel(
@@ -98,6 +139,7 @@ def _rollout_kernel(
     T: int,
     obs_dim: int,
     hidden: int,
+    act_dim: int,
     step_soa: Callable,
     obs_soa: Callable,
     state_keys: Tuple[str, ...],
@@ -108,7 +150,7 @@ def _rollout_kernel(
     def body(_, carry):
         state, total = carry
         obs = obs_soa(state)
-        a = _mlp_act(theta_ref, obs, obs_dim, hidden)
+        a = _mlp_act(theta_ref, obs, obs_dim, hidden, act_dim)
         state, reward = step_soa(state, a)
         return state, total + reward
 
@@ -119,7 +161,8 @@ def _rollout_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "T", "obs_dim", "hidden", "step_soa", "obs_soa", "tile", "interpret"
+        "T", "obs_dim", "hidden", "act_dim", "step_soa", "obs_soa", "tile",
+        "episodes", "interpret",
     ),
 )
 def fused_rollout(
@@ -128,23 +171,35 @@ def fused_rollout(
     T: int,
     obs_dim: int = 3,
     hidden: int = 16,
+    act_dim: int = 1,
     step_soa: Callable = pendulum_step_soa,
     obs_soa: Callable = pendulum_obs_soa,
-    tile: int = 1024,
+    tile: int = 2048,
+    episodes: int = 1,
     interpret: bool = False,
 ) -> jax.Array:
     """Total episode reward per environment, fully fused.
 
     Args:
-        theta: ``(n_envs, dim)`` flat MLP genomes (one row per env; repeat
-            rows for multiple episodes per individual).
-        init_state: SoA env state dict of ``(n_envs,)`` arrays.
+        theta: ``(n, dim)`` flat MLP genomes (one row per individual).
+            Layout per ``flat_mlp_policy`` (policy.py).
+        init_state: SoA env state dict of ``(episodes * n,)`` arrays,
+            EPISODE-MAJOR (all of episode 0's envs, then episode 1's...).
         T: fixed episode length.
-        obs_dim / hidden: MLP shape (act_dim is 1).
+        obs_dim / hidden / act_dim: MLP shape.
         step_soa / obs_soa: the env's SoA step/observation functions (any
             jax-traceable elementwise math over the component arrays).
         tile: environments per Pallas grid cell; theta tile must fit VMEM
-            (tile x dim x 4 bytes, default 1024 x 81 ≈ 330 KB).
+            (tile x dim x 4 bytes, default 2048 x 81 ≈ 660 KB — the
+            measured v5e optimum, PERF_NOTES §8).
+        episodes: episodes per individual. The grid is 2-D
+            ``(episodes, n/tile)`` and the theta BlockSpec maps every
+            episode row to the same genome block, so multi-episode
+            evaluation re-reads theta from HBM instead of materializing a
+            ``jnp.repeat``-ed copy.
+
+    Returns:
+        ``(episodes * n,)`` total rewards, episode-major.
     """
     if not (_HAS_PLTPU or interpret):
         raise RuntimeError(
@@ -153,28 +208,46 @@ def fused_rollout(
     if tile % (8 * _LANES) != 0:
         raise ValueError(f"tile must be a multiple of {8 * _LANES}, got {tile}")
     n, dim = theta.shape
+    expect_dim = obs_dim * hidden + hidden + hidden * act_dim + act_dim
+    if dim != expect_dim:
+        raise ValueError(
+            f"theta dim {dim} != flat MLP size {expect_dim} for "
+            f"({obs_dim} -> {hidden} -> {act_dim})"
+        )
+    if jax.tree.leaves(init_state)[0].shape[0] != episodes * n:
+        raise ValueError(
+            f"init_state has {jax.tree.leaves(init_state)[0].shape[0]} envs, "
+            f"expected episodes*n = {episodes * n}"
+        )
     pad = (-n) % tile
+    n_pad = n + pad
     if pad:
         theta = jnp.pad(theta, ((0, pad), (0, 0)))
-        init_state = {k: jnp.pad(v, (0, pad)) for k, v in init_state.items()}
-    n_pad = n + pad
+        # pad each episode segment so segments stay tile-aligned
+        init_state = {
+            k: jnp.pad(v.reshape(episodes, n), ((0, 0), (0, pad))).reshape(-1)
+            for k, v in init_state.items()
+        }
     # every per-env quantity becomes a full (sublane, lane) = (8k, 128m)
     # tile: genome components are (rows, LANES) planes of a 3-D theta
     # block, env state components are matching 2-D tiles — all kernel ops
     # are full-width VPU instructions (1-D (tile,) values waste 7/8
     # sublanes and measured ~5x slower)
-    rows_total = n_pad // _LANES
+    rows_pop = n_pad // _LANES
     rows_tile = tile // _LANES
-    theta_t = theta.T.reshape(dim, rows_total, _LANES)
-    state_2d = {
-        k: v.reshape(rows_total, _LANES) for k, v in sorted(init_state.items())
+    blocks = rows_pop // rows_tile
+    theta_t = theta.T.reshape(dim, rows_pop, _LANES)
+    state_3d = {
+        k: v.reshape(episodes, rows_pop, _LANES)
+        for k, v in sorted(init_state.items())
     }
-    state_keys = tuple(state_2d)
+    state_keys = tuple(state_3d)
     kernel = functools.partial(
         _rollout_kernel,
         T=T,
         obs_dim=obs_dim,
         hidden=hidden,
+        act_dim=act_dim,
         step_soa=step_soa,
         obs_soa=obs_soa,
         state_keys=state_keys,
@@ -185,14 +258,19 @@ def fused_rollout(
 
     total = pl.pallas_call(
         wrapped,
-        grid=(rows_total // rows_tile,),
-        in_specs=[pl.BlockSpec((dim, rows_tile, _LANES), lambda i: (0, i, 0))]
+        grid=(episodes, blocks),
+        in_specs=[
+            pl.BlockSpec((dim, rows_tile, _LANES), lambda e, b: (0, b, 0))
+        ]
         + [
-            pl.BlockSpec((rows_tile, _LANES), lambda i: (i, 0))
+            pl.BlockSpec((1, rows_tile, _LANES), lambda e, b: (e, b, 0))
             for _ in state_keys
         ],
-        out_specs=pl.BlockSpec((rows_tile, _LANES), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((rows_total, _LANES), theta.dtype),
+        out_specs=pl.BlockSpec((1, rows_tile, _LANES), lambda e, b: (e, b, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (episodes, rows_pop, _LANES), theta.dtype
+        ),
         interpret=interpret,
-    )(theta_t, *state_2d.values())
-    return total.reshape(n_pad)[:n]
+    )(theta_t, *state_3d.values())
+    total = total.reshape(episodes, n_pad)[:, :n]
+    return total.reshape(episodes * n)

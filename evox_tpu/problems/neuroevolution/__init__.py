@@ -5,7 +5,7 @@ from .rollout import (
     RolloutState,
     Trajectory,
 )
-from .policy import mlp_policy
+from .policy import flat_mlp_policy, mlp_policy
 from .control import envs
 from .hostenv import HostEnvProblem, HostVectorEnv, NumpyCartPoleVec, envpool_make
 from .rollout_farm import HostRolloutFarm
@@ -24,6 +24,7 @@ __all__ = [
     "ObsNormalizer",
     "PolicyRolloutProblem",
     "RolloutState",
+    "flat_mlp_policy",
     "mlp_policy",
     "envs",
 ]

@@ -23,6 +23,37 @@ import jax
 import jax.numpy as jnp
 
 
+def flat_mlp_policy(
+    obs_dim: int, hidden: int, act_dim: int = 1
+) -> Tuple[Callable, int]:
+    """One-hidden-layer tanh MLP over a FLAT genome vector.
+
+    Returns ``(apply, dim)`` where ``apply(theta, obs) -> action`` consumes
+    a ``(dim,)`` genome laid out ``[w1 row-major, b1, w2 row-major, b2]``
+    — the layout the fused Pallas rollout kernel
+    (:func:`~evox_tpu.kernels.rollout.fused_rollout`) reads directly, so a
+    population evolved against this policy can switch between the scan and
+    fused engines with bit-compatible genomes. ES algorithms consume the
+    flat ``(pop, dim)`` population with no tree transform at all.
+
+    Uses the VPU broadcast-multiply-reduce form (module docstring).
+    """
+    n1 = obs_dim * hidden
+    n2 = n1 + hidden
+    n3 = n2 + hidden * act_dim
+    dim = n3 + act_dim
+
+    def apply(theta: jax.Array, obs: jax.Array) -> jax.Array:
+        w1 = theta[:n1].reshape(obs_dim, hidden)
+        b1 = theta[n1:n2]
+        w2 = theta[n2:n3].reshape(hidden, act_dim)
+        b2 = theta[n3:]
+        h = jnp.tanh(jnp.sum(obs[..., :, None] * w1, axis=-2) + b1)
+        return jnp.sum(h[..., :, None] * w2, axis=-2) + b2
+
+    return apply, dim
+
+
 def mlp_policy(
     layer_sizes: Sequence[int],
     activation: Callable = jnp.tanh,

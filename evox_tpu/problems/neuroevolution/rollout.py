@@ -154,6 +154,23 @@ class PolicyRolloutProblem(Problem):
             throughput win at large populations. Incompatible with
             ``cap_episode`` (the cap is a traced bound).
         unroll: scan unroll factor for the ``early_exit=False`` path.
+        fused_env: an :class:`~evox_tpu.kernels.rollout.SoAEnv` — switches
+            ``evaluate`` to the fused Pallas rollout kernel
+            (:func:`~evox_tpu.kernels.rollout.fused_rollout`): the whole
+            fixed-horizon episode runs inside one kernel with genomes, env
+            state and activations resident in VMEM (one theta read + one
+            fitness write of HBM traffic per env, vs one carry round-trip
+            per step for the scan engine). Requires ``early_exit=False``,
+            no ``cap_episode``/``obs_normalizer``, a flat ``(pop, dim)``
+            population in :func:`flat_mlp_policy` layout, and a
+            never-terminating env. Initial states still come from
+            ``fused_env.base.reset`` with the same keys as the scan engine,
+            so the two engines are numerics-compatible (pinned by
+            tests/test_kernels.py).
+        fused_tile: environments per Pallas grid cell (multiple of 1024;
+            2048 measured best on v5e — PERF_NOTES §8).
+        fused_interpret: run the kernel in interpreter mode (None = auto:
+            interpret on the CPU backend, compiled elsewhere).
     """
 
     def __init__(
@@ -168,6 +185,9 @@ class PolicyRolloutProblem(Problem):
         obs_normalizer: Optional[ObsNormalizer] = None,
         early_exit: bool = True,
         unroll: int = 4,
+        fused_env: Optional["SoAEnv"] = None,
+        fused_tile: int = 2048,
+        fused_interpret: Optional[bool] = None,
     ):
         self.policy = policy
         self.env = env
@@ -181,6 +201,47 @@ class PolicyRolloutProblem(Problem):
             raise ValueError("early_exit=False cannot be combined with cap_episode")
         self.early_exit = early_exit
         self.unroll = unroll
+        if fused_env is not None:
+            if early_exit:
+                raise ValueError(
+                    "fused_env requires early_exit=False (the kernel runs a "
+                    "fixed-horizon fori_loop)"
+                )
+            if cap_episode is not None or obs_normalizer is not None:
+                raise ValueError(
+                    "fused_env cannot be combined with cap_episode or "
+                    "obs_normalizer"
+                )
+        self.fused_env = fused_env
+        self.fused_tile = fused_tile
+        self.fused_interpret = fused_interpret
+        self._fused_policy_checked = False
+
+    def _check_fused_policy(self, dim: int, hidden: int) -> None:
+        """One-time concrete probe: ``self.policy`` must agree with the
+        kernel's flat-MLP math, else evolution would silently optimize a
+        different network than the ``policy`` the user later deploys."""
+        import numpy as np
+
+        from ...kernels.rollout import _mlp_act
+
+        obs_dim, act_dim = self.env.obs_dim, self.env.act_dim
+        rng = np.random.default_rng(0)
+        theta = jnp.asarray(rng.normal(size=(dim,)), dtype=jnp.float32)
+        obs = jnp.asarray(rng.normal(size=(obs_dim,)), dtype=jnp.float32)
+        want = _mlp_act(
+            theta[:, None], tuple(obs[k : k + 1] for k in range(obs_dim)),
+            obs_dim, hidden, act_dim,
+        )
+        want = np.asarray(jnp.concatenate(want))
+        got = np.asarray(self.policy(theta, obs)).reshape(-1)
+        if got.shape != want.shape or not np.allclose(got, want, atol=1e-5):
+            raise ValueError(
+                "fused_env requires the policy to be the flat tanh MLP the "
+                "kernel implements (use flat_mlp_policy); the supplied "
+                "policy disagrees with the kernel math on a probe input"
+            )
+        self._fused_policy_checked = True
 
     def init(self, key=None) -> RolloutState:
         return RolloutState(
@@ -189,7 +250,69 @@ class PolicyRolloutProblem(Problem):
             norm=self.obs_normalizer.init() if self.obs_normalizer else None,
         )
 
+    def _evaluate_fused(
+        self, state: RolloutState, pop: Any
+    ) -> Tuple[jax.Array, RolloutState]:
+        """Fused-kernel engine: same key/reset/reduce semantics as the scan
+        engine, the episode loop replaced by one Pallas program per env
+        tile (kernels/rollout.py)."""
+        from ...kernels.rollout import fused_rollout
+
+        key = state.key
+        if self.stochastic_reset:
+            key, k_eps = jax.random.split(key)
+        else:
+            k_eps = jax.random.fold_in(key, 0)
+        pop = jnp.asarray(pop)
+        pop_size, dim = pop.shape
+        ep = self.num_episodes
+        obs_dim, act_dim = self.env.obs_dim, self.env.act_dim
+        hidden, rem = divmod(dim - act_dim, obs_dim + 1 + act_dim)
+        if rem:
+            raise ValueError(
+                f"population dim {dim} is not a flat_mlp_policy genome for "
+                f"obs_dim={obs_dim}, act_dim={act_dim}"
+            )
+        if not self._fused_policy_checked:
+            self._check_fused_policy(dim, hidden)
+
+        # same episode seeds/reset draws as the scan engine (common random
+        # numbers across the population), then AoS -> SoA component planes,
+        # EPISODE-MAJOR so the kernel re-reads one theta per episode block
+        # instead of a jnp.repeat-ed copy
+        ep_keys = jax.random.split(k_eps, ep)
+        env_state0 = jax.vmap(self.fused_env.base.reset)(ep_keys)  # (ep, ...)
+        env_flat = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                x[:, None], (ep, pop_size) + x.shape[1:]
+            ).reshape((ep * pop_size,) + x.shape[1:]),
+            env_state0,
+        )
+        soa0 = self.fused_env.to_soa(env_flat)
+        interpret = self.fused_interpret
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        totals = fused_rollout(
+            pop,
+            soa0,
+            T=int(self.max_len),
+            obs_dim=obs_dim,
+            hidden=hidden,
+            act_dim=act_dim,
+            step_soa=self.fused_env.step_soa,
+            obs_soa=self.fused_env.obs_soa,
+            tile=self.fused_tile,
+            episodes=ep,
+            interpret=interpret,
+        )
+        # (ep, pop) episode-major -> (pop, ep) so reduce_fn sees the same
+        # axis convention as the scan engine
+        fitness = self.reduce_fn(totals.reshape(ep, pop_size).T, axis=-1)
+        return fitness, RolloutState(key=key, cap=state.cap, norm=state.norm)
+
     def evaluate(self, state: RolloutState, pop: Any) -> Tuple[jax.Array, RolloutState]:
+        if self.fused_env is not None:
+            return self._evaluate_fused(state, pop)
         key = state.key
         if self.stochastic_reset:
             key, k_eps = jax.random.split(key)

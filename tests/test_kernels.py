@@ -95,3 +95,175 @@ def test_packed_dominance_rejects_bad_tiles():
         packed_dominance(fit, use_pallas=True, interpret=True, tile_i=48)
     with pytest.raises(ValueError, match="tile_j"):
         packed_dominance(fit, use_pallas=True, interpret=True, tile_j=100)
+
+
+# ------------------------------------------------------------ fused rollout
+# The fused episode kernel must be numerics-pinned to the scan engine it
+# replaces (PolicyRolloutProblem early_exit=False) — same keys, same reset
+# draws, same fitness up to float-summation-order noise — and bit-exact
+# against the same SoA math run outside Pallas.
+
+from evox_tpu.kernels.rollout import (  # noqa: E402
+    _mlp_act,
+    fused_rollout,
+    pendulum_obs_soa,
+    pendulum_soa,
+    pendulum_step_soa,
+)
+from evox_tpu.problems.neuroevolution import (  # noqa: E402
+    PolicyRolloutProblem,
+    flat_mlp_policy,
+)
+
+
+def _loop_reference(theta, init_state, T, obs_dim, hidden, act_dim,
+                    step_soa, obs_soa):
+    """The kernel's own math on full (n,) arrays, outside Pallas: identical
+    op order, so interpret-mode equality must be exact."""
+    state = dict(init_state)
+    total = jnp.zeros_like(state[sorted(state)[0]])
+    theta_t = theta.T  # (dim, n): theta_t[i] is one genome component row
+    for _ in range(T):
+        obs = obs_soa(state)
+        a = _mlp_act(theta_t, obs, obs_dim, hidden, act_dim)
+        state, r = step_soa(state, a)
+        total = total + r
+    return total
+
+
+@pytest.mark.parametrize("n", [5, 1024, 1500])
+def test_fused_rollout_exact_vs_soa_loop(n):
+    """Tiling, transpose, padding and the in-kernel loop reproduce the SoA
+    math exactly (n=5 exercises padding, 1500 a ragged final tile)."""
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    obs_dim, hidden, act_dim, T = 3, 8, 1, 7
+    dim = obs_dim * hidden + hidden + hidden * act_dim + act_dim
+    theta = 0.5 * jax.random.normal(k1, (n, dim))
+    s0 = {
+        "th": jax.random.uniform(k2, (n,), minval=-jnp.pi, maxval=jnp.pi),
+        "thdot": jnp.linspace(-1.0, 1.0, n),
+    }
+    got = fused_rollout(
+        theta, s0, T=T, obs_dim=obs_dim, hidden=hidden, act_dim=act_dim,
+        interpret=True,
+    )
+    want = _loop_reference(
+        theta, s0, T, obs_dim, hidden, act_dim,
+        pendulum_step_soa, pendulum_obs_soa,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_fused_rollout_multi_action_env():
+    """act_dim > 1 goes through the generalized _mlp_act and a step_soa
+    consuming an action tuple."""
+
+    def step2(s, a):
+        x = s["x"] + 0.1 * jnp.tanh(a[0])
+        v = s["v"] + 0.1 * jnp.tanh(a[1])
+        return {"x": x, "v": v}, -(x**2 + v**2)
+
+    def obs2(s):
+        return (s["x"], s["v"])
+
+    n, obs_dim, hidden, act_dim, T = 33, 2, 4, 2, 5
+    dim = obs_dim * hidden + hidden + hidden * act_dim + act_dim
+    key = jax.random.PRNGKey(3)
+    theta = jax.random.normal(key, (n, dim))
+    s0 = {"x": jnp.linspace(-1, 1, n), "v": jnp.zeros(n)}
+    got = fused_rollout(
+        theta, s0, T=T, obs_dim=obs_dim, hidden=hidden, act_dim=act_dim,
+        step_soa=step2, obs_soa=obs2, interpret=True,
+    )
+    want = _loop_reference(theta, s0, T, obs_dim, hidden, act_dim, step2, obs2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_fused_rollout_episode_major_grid():
+    """episodes > 1 re-reads the same theta block per episode row; result
+    must equal rolling out the repeated-theta layout explicitly."""
+    pop, ep, T = 20, 3, 6
+    obs_dim, hidden, act_dim = 3, 8, 1
+    dim = obs_dim * hidden + hidden + hidden * act_dim + act_dim
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    theta = 0.4 * jax.random.normal(k1, (pop, dim))
+    s0 = {
+        "th": jax.random.uniform(k2, (ep * pop,), minval=-jnp.pi, maxval=jnp.pi),
+        "thdot": jnp.zeros(ep * pop),
+    }
+    got = fused_rollout(
+        theta, s0, T=T, obs_dim=obs_dim, hidden=hidden, act_dim=act_dim,
+        episodes=ep, interpret=True,
+    )
+    theta_rep = jnp.tile(theta, (ep, 1))  # episode-major repeat
+    want = _loop_reference(
+        theta_rep, s0, T, obs_dim, hidden, act_dim,
+        pendulum_step_soa, pendulum_obs_soa,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+@pytest.mark.parametrize("stochastic_reset", [False, True])
+def test_fused_engine_matches_scan_engine(stochastic_reset):
+    """PolicyRolloutProblem(fused_env=...) reproduces the scan engine's
+    fitness and key threading — the wiring contract, not just the kernel."""
+    soa = pendulum_soa(max_steps=60)
+    apply, dim = flat_mlp_policy(soa.base.obs_dim, 16, soa.base.act_dim)
+    kw = dict(
+        num_episodes=2,
+        stochastic_reset=stochastic_reset,
+        early_exit=False,
+    )
+    scan_prob = PolicyRolloutProblem(apply, soa.base, **kw)
+    fused_prob = PolicyRolloutProblem(
+        apply, soa.base, fused_env=soa, fused_interpret=True, **kw
+    )
+    pop = 0.3 * jax.random.normal(jax.random.PRNGKey(1), (9, dim))
+    s_scan = scan_prob.init(jax.random.PRNGKey(5))
+    s_fused = fused_prob.init(jax.random.PRNGKey(5))
+    for _ in range(2):  # two generations: exercises key threading too
+        f_scan, s_scan = scan_prob.evaluate(s_scan, pop)
+        f_fused, s_fused = fused_prob.evaluate(s_fused, pop)
+        np.testing.assert_allclose(
+            np.asarray(f_fused), np.asarray(f_scan), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_fused.key), np.asarray(s_scan.key)
+        )
+
+
+def test_fused_engine_validation():
+    soa = pendulum_soa()
+    apply, dim = flat_mlp_policy(3, 16, 1)
+    with pytest.raises(ValueError, match="early_exit"):
+        PolicyRolloutProblem(apply, soa.base, fused_env=soa)
+    prob = PolicyRolloutProblem(
+        apply, soa.base, early_exit=False, fused_env=soa, fused_interpret=True
+    )
+    state = prob.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="flat_mlp_policy"):
+        prob.evaluate(state, jnp.zeros((4, dim + 1)))
+
+
+def test_fused_engine_rejects_mismatched_policy():
+    """A same-dim policy with different semantics (relu instead of tanh)
+    must be rejected by the probe check, not silently mis-evaluated."""
+    soa = pendulum_soa()
+    _, dim = flat_mlp_policy(3, 16, 1)
+
+    def relu_apply(theta, obs):
+        w1 = theta[: 3 * 16].reshape(3, 16)
+        b1 = theta[3 * 16 : 4 * 16]
+        w2 = theta[4 * 16 : 5 * 16].reshape(16, 1)
+        b2 = theta[5 * 16 :]
+        h = jnp.maximum(jnp.sum(obs[..., :, None] * w1, axis=-2) + b1, 0.0)
+        return jnp.sum(h[..., :, None] * w2, axis=-2) + b2
+
+    prob = PolicyRolloutProblem(
+        relu_apply, soa.base, early_exit=False, fused_env=soa,
+        fused_interpret=True,
+    )
+    state = prob.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="disagrees"):
+        prob.evaluate(state, jnp.zeros((4, dim)))
