@@ -1,20 +1,23 @@
 """Benchmark driver: evox_tpu mesh-native workflow vs the reference (EvoX 0.8.1).
 
-Three workloads, each run through (a) evox_tpu's single-jitted-step/fused-run
+Four workloads, each run through (a) evox_tpu's single-jitted-step/fused-run
 StdWorkflow and (b) the reference's StdWorkflow imported from
 /root/reference/src (pure-JAX, so it runs on the same chip — an honest
 apples-to-apples baseline):
 
 1. CSO on Ackley (pop=4096, dim=1024) — elementwise/dispatch throughput.
 2. OpenES + policy rollouts at pop=65536 (pendulum MLP, the north-star
-   neuroevolution shape; both sides run the identical double-vmap
-   ``lax.while_loop`` rollout, mirroring reference brax.py:62-97, so the
-   comparison isolates framework/algorithm machinery).
+   neuroevolution shape): ours runs the fused Pallas episode kernel, the
+   reference its double-vmap ``lax.while_loop`` (brax.py:62-97 shape).
+2b. OpenES + chain_walker (obs=244, act=17, dim=20945 policy) — the
+   Brax-Humanoid workload scale, both sides on the identical while_loop
+   rollout.
 3. NSGA-II on LSMOP1 (m=3, d=300, pop=10000) — the O(N²) MO selection path
    (reference nsga2.py:89-96 merge + non-dominated sort at N=20000).
 
-Prints one JSON line per metric, then a final summary line whose value is the
-geometric-mean speedup and which embeds all sub-metrics.
+Prints one JSON line per metric (with analytic FLOPs/bytes roofline context),
+then a final summary line whose value is the geometric-mean speedup and which
+embeds all sub-metrics.
 """
 
 from __future__ import annotations
@@ -184,6 +187,89 @@ def bench_rollout_ref():
     return _loop_measurer(wf.step, state, RO_STEPS), RO_POP
 
 
+# ----------------------------------------------------------------- workload 2b
+# OpenES + the humanoid-scale walker (chain_walker: obs=244, act=17, contact
+# physics, termination on falling — the Brax-Humanoid workload shape from
+# BASELINE.md, reference brax.py:45-97). 2-hidden-layer MLP (244-64-64-17,
+# dim=20945); pop=16384 keeps BOTH frameworks' (pop, dim) states co-resident
+# during interleaved measurement inside one chip's 16 GB HBM (32768 fits one
+# side alone; 65536 OOMs outright at dim 20945). The workload is HBM-bound
+# on per-step policy-weight re-reads; ours runs the big-policy fused kernel
+# (kernels/rollout_mlp.py: a tile of individuals' full weight matrices
+# resident in VMEM across the episode — measured ~6x the scan engine,
+# PERF_NOTES §9), the reference its double-vmap while_loop engine shape.
+
+W_POP, W_STEPS, W_HIDDEN, W_MAXLEN = 16384, 3, 64, 100
+
+
+def _walker_problem(fused: bool = False):
+    from evox_tpu.kernels.rollout_mlp import chain_walker_planes
+    from evox_tpu.problems.neuroevolution import PolicyRolloutProblem, mlp_policy
+    from evox_tpu.utils import TreeAndVector
+
+    penv = chain_walker_planes(max_steps=W_MAXLEN)
+    env = penv.base
+    init_params, apply = mlp_policy((env.obs_dim, W_HIDDEN, W_HIDDEN, env.act_dim))
+    adapter = TreeAndVector(init_params(jax.random.PRNGKey(0)))
+    prob = PolicyRolloutProblem(
+        apply,
+        env,
+        num_episodes=1,
+        stochastic_reset=False,
+        fused_planes=penv if fused else None,
+    )
+    return prob, adapter
+
+
+def bench_walker_ours():
+    from evox_tpu import StdWorkflow
+    from evox_tpu.algorithms.so.es import OpenES
+    from evox_tpu.utils import rank_based_fitness
+
+    prob, adapter = _walker_problem(fused=True)
+    algo = OpenES(jnp.zeros(adapter.dim), W_POP, learning_rate=0.05, noise_stdev=0.05)
+    wf = StdWorkflow(
+        algo,
+        prob,
+        opt_direction="max",
+        pop_transforms=(adapter.batched_to_tree,),
+        fit_transforms=(rank_based_fitness,),
+    )
+    state = wf.init(jax.random.PRNGKey(0))
+    return _run_measurer(wf, state, W_STEPS), W_POP
+
+
+def bench_walker_ref():
+    from evox import Problem, State, algorithms as ralg, workflows as rwf
+    from evox_tpu.utils import rank_based_fitness
+
+    prob, adapter = _walker_problem()
+    rollout_state = prob.init(jax.random.PRNGKey(7))
+
+    class RefWalker(Problem):
+        def setup(self, key):
+            return State(key=key)
+
+        def evaluate(self, state, pop):
+            fit, _ = prob.evaluate(rollout_state, pop)
+            return fit, state
+
+    algo = ralg.OpenES(
+        jnp.zeros(adapter.dim), W_POP, learning_rate=0.05, noise_stdev=0.05
+    )
+    wf = rwf.StdWorkflow(
+        algo,
+        RefWalker(),
+        opt_direction="max",
+        candidate_transforms=(adapter.batched_to_tree,),
+        fitness_transforms=(rank_based_fitness,),
+    )
+    state = wf.init(jax.random.PRNGKey(0))
+    for _ in range(WARMUP):
+        state = wf.step(state)
+    return _loop_measurer(wf.step, state, W_STEPS), W_POP
+
+
 # ------------------------------------------------------------------ workload 3
 
 MO_POP, MO_DIM, MO_M, MO_STEPS = 10000, 300, 3, 10
@@ -218,24 +304,74 @@ def bench_nsga2_ref():
 
 # ----------------------------------------------------------------------- main
 
+# Analytic roofline estimates per unit of the workload's metric (one eval,
+# or one generation for NSGA-II), so the driver sees achieved GFLOP/s and
+# GB/s next to the drift-sensitive ratio (v5e-1 peaks: ~197 TFLOP/s bf16 /
+# ~98 f32, ~819 GB/s HBM). "bytes" counts the dominant HBM traffic of OUR
+# implementation: the fused rollout reads theta once per episode; the
+# walker re-reads all policy weights every env step; CSO streams the
+# population a handful of times; the NSGA-II peel streams the bit-packed
+# dominance matrix.
+ROOFLINES = {
+    "cso": {
+        # Ackley ~7 flops/dim + CSO update ~12 flops/dim (2 madds-heavy
+        # passes); population row streamed ~6x (eval, compare, update)
+        "flops_per_eval": 19 * CSO_DIM,
+        "bytes_per_eval": 6 * 4 * CSO_DIM,
+    },
+    "rollout": {
+        # per eval: episodes x T x (MLP 2*(3*16+16*2) + env ~40 flops);
+        # fused kernel HBM traffic: theta read/episode + fitness write
+        "flops_per_eval": RO_EPISODES * 200 * 300,
+        "bytes_per_eval": RO_EPISODES * 4 * 81 + 8,
+        "flops_per_eval_note": "episodes*T*(mlp+env)",
+    },
+    "walker": {
+        # per eval: <=T x (policy 2*(244*64+64*64+64*17) + physics
+        # 25 masses * 5 substeps * ~60 flops); the fused kernel reads the
+        # weights ONCE per episode (the scan engine re-reads them every
+        # step: T * 4 * 20945 bytes — the roofline the kernel removed)
+        "flops_per_eval": W_MAXLEN * (2 * (244 * 64 + 64 * 64 + 64 * 17) + 7500),
+        "bytes_per_eval": 4 * 20945,
+    },
+    "nsga2": {
+        # per gen at N=2*pop merged: dominance build 2*N^2*m compares +
+        # ~6 peel passes over the packed N^2/8 matrix + crowding sorts
+        "flops_per_eval": 2 * (2 * MO_POP) ** 2 * MO_M,
+        "bytes_per_eval": 6 * (2 * MO_POP) ** 2 // 8,
+        "flops_per_eval_note": "per generation, dominated by the O(N^2) sort",
+    },
+}
+
 WORKLOADS = [
     (
         f"CSO/Ackley evals/sec (pop={CSO_POP}, dim={CSO_DIM})",
         "evals/sec",
         bench_cso_ours,
         bench_cso_ref,
+        ROOFLINES["cso"],
     ),
     (
         f"OpenES+rollout evals/sec (pendulum MLP, pop={RO_POP})",
         "evals/sec",
         bench_rollout_ours,
         bench_rollout_ref,
+        ROOFLINES["rollout"],
+    ),
+    (
+        f"OpenES+walker evals/sec (humanoid-scale: obs=244 act=17 "
+        f"dim=20945, pop={W_POP})",
+        "evals/sec",
+        bench_walker_ours,
+        bench_walker_ref,
+        ROOFLINES["walker"],
     ),
     (
         f"NSGA-II/LSMOP1 gens/sec (pop={MO_POP}, d={MO_DIM}, m={MO_M})",
         "gens/sec",
         bench_nsga2_ours,
         bench_nsga2_ref,
+        ROOFLINES["nsga2"],
     ),
 ]
 
@@ -244,7 +380,7 @@ def main() -> None:
     _patch_reference_imports()
     sys.path.insert(0, "/root/reference/src")
     results = []
-    for metric, unit, ours_fn, ref_fn in WORKLOADS:
+    for metric, unit, ours_fn, ref_fn, roofline in WORKLOADS:
         measure_ours, scale = ours_fn()
         try:
             measure_ref, _ = ref_fn()
@@ -272,6 +408,12 @@ def main() -> None:
             "value": round(ours, 3),
             "unit": unit,
             "vs_baseline": round(ours / ref, 3) if ref else None,
+            # roofline context (MFU-style): analytic flops/bytes per unit
+            # of the metric and the achieved rates they imply
+            "flops_per_eval": roofline["flops_per_eval"],
+            "bytes_per_eval": roofline["bytes_per_eval"],
+            "achieved_gflops": round(ours * roofline["flops_per_eval"] / 1e9, 1),
+            "achieved_gbps": round(ours * roofline["bytes_per_eval"] / 1e9, 1),
         }
         results.append(entry)
         print(json.dumps(entry), flush=True)

@@ -21,7 +21,7 @@ from .core import (
     POP_AXIS,
 )
 from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
-from .workflows import IslandWorkflow, StdWorkflow
+from .workflows import IslandWorkflow, StdWorkflow, run_host_pipelined
 
 __all__ = [
     "Algorithm",
@@ -35,6 +35,7 @@ __all__ = [
     "POP_AXIS",
     "StdWorkflow",
     "IslandWorkflow",
+    "run_host_pipelined",
     "algorithms",
     "core",
     "monitors",

@@ -89,5 +89,32 @@ class GAMOAlgorithm(Algorithm):
         pop, fit = self.select(state, merged_pop, merged_fit)
         return state.replace(population=pop, fitness=fit)
 
+    # -- migration ------------------------------------------------------------
+    def migrate(self, state: MOState, pop: jax.Array, fitness: jax.Array):
+        """Multi-objective migration (IslandWorkflow): merge migrants into
+        the population and re-run NSGA-II-style (rank, crowding)
+        environmental truncation — elitist, so a dominated migrant simply
+        doesn't survive. This deliberately uses the rank+crowding criterion
+        for every GA-skeleton MOEA (not the subclass's own ``select``):
+        migration needs a cheap, universally-valid elitism test, and the
+        algorithm's own selection reshapes the population next ``tell``
+        anyway. States that cache (rank, crowd) mating keys (e.g. NSGA-II)
+        get them refreshed to match the post-migration population."""
+        from ...operators.selection.non_dominate import (
+            crowding_distance,
+            rank_crowding_truncate,
+        )
+
+        merged_pop = jnp.concatenate([state.population, pop], axis=0)
+        merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
+        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size)
+        fit_sel = merged_fit[order]
+        updates = dict(population=merged_pop[order], fitness=fit_sel)
+        if hasattr(state, "rank"):
+            updates["rank"] = ranks
+        if hasattr(state, "crowd"):
+            updates["crowd"] = crowding_distance(fit_sel)
+        return state.replace(**updates)
+
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
         raise NotImplementedError

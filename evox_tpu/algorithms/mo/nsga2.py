@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ...operators.selection.non_dominate import (
     crowding_distance,
     non_dominated_sort,
+    rank_crowding_truncate,
 )
 from ...operators.selection.basic import tournament_multifit
 from .common import GAMOAlgorithm, MOState
@@ -55,15 +56,12 @@ class NSGA2(GAMOAlgorithm):
     def tell(self, state: NSGA2State, fitness: jax.Array) -> NSGA2State:
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
-        rank = non_dominated_sort(merged_fit, until=self.pop_size)
-        worst_rank = jnp.sort(rank)[self.pop_size - 1]
-        crowd = crowding_distance(merged_fit, mask=rank == worst_rank)
-        order = jnp.lexsort((-crowd, rank))[: self.pop_size]
+        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size)
         fit_sel = merged_fit[order]
         return state.replace(
             population=merged_pop[order],
             fitness=fit_sel,
-            rank=rank[order],
+            rank=ranks,
             # crowding for next generation's mating tournament is recomputed
             # over the survivors (the cut's crowding is masked to the worst
             # front and would leave -inf for the better fronts)

@@ -3,11 +3,15 @@ Evolution Strategies via Meta-Black-Box Optimization", arXiv:2211.11260).
 
 Capability parity with reference src/evox/algorithms/so/es_variants/les.py,
 which loads meta-trained parameters from an evosax pickle at import time
-(reference les.py:26-33). This build has no network egress, so no pretrained
-weights are bundled: pass meta-learned parameters via ``params``; with
-``params=None`` the attention network runs from a seeded random
-initialization, which still yields a working (if un-meta-trained) ES — the
-fitness-feature pipeline, attention-based recombination weights, and
+(reference les.py:26-33). This build has no network egress, so the
+parameters are meta-trained IN-REPO instead (les_meta.py: outer OpenES
+over the network weights, meta-fitness = LES's optimization performance
+on a shifted/rotated sphere/ellipsoid/rastrigin/rosenbrock task
+distribution) and bundled at ``data/les_params.npz``. The default
+``params="auto"`` loads that artifact, so LES is actually *learned* out
+of the box; ``params=None`` runs a seeded random initialization (useful
+as the un-trained baseline), and an explicit pytree is used verbatim.
+The fitness-feature pipeline, attention-based recombination weights, and
 learning-rate modulation network match the paper's architecture.
 """
 
@@ -70,7 +74,7 @@ class LES(Algorithm):
         center_init,
         init_stdev: float = 1.0,
         pop_size: int = 16,
-        params: Optional[Any] = None,
+        params: Optional[Any] = "auto",
         params_seed: int = 0,
     ):
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
@@ -80,6 +84,10 @@ class LES(Algorithm):
         self.timescales = jnp.asarray([0.1, 0.5, 0.9], dtype=jnp.float32)
         self.weight_net = _AttentionWeights()
         self.lr_net = _LrModulator()
+        if isinstance(params, str) and params == "auto":
+            from .les_meta import load_params
+
+            params = load_params()  # None if no bundled artifact
         if params is None:
             k1, k2 = jax.random.split(jax.random.PRNGKey(params_seed))
             params = {

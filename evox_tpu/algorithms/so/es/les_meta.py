@@ -1,0 +1,185 @@
+"""Meta-training for LES (Lange et al. 2023, arXiv:2211.11260 §4).
+
+The reference ships evosax's meta-trained LES parameters via a pickle
+download (reference src/evox/algorithms/so/es_variants/les.py:26-33).
+This build has no network egress, so the capability is reproduced
+in-repo: this module meta-trains the LES attention/learning-rate
+networks by meta-black-box optimization — an outer OpenES over the
+~200 network parameters, whose meta-fitness is LES's own optimization
+performance over a task distribution (shifted/rotated sphere,
+ill-conditioned ellipsoid, rastrigin, rosenbrock) — the same recipe as
+the paper, at a smaller scale. The resulting parameters are bundled at
+``data/les_params.npz`` and loaded by ``LES(params="auto")`` (the
+default); ``python -m evox_tpu.algorithms.so.es.les_meta`` regenerates
+them.
+
+Both LES networks are shape-agnostic (the attention net is pop-wise,
+the lr net dimension-wise), so parameters trained at dim=8/pop=16
+transfer to other dims and population sizes — the held-out test
+(tests/test_so_es.py) runs them at dim=12.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+from .les import LES
+from .open_es import OpenES
+
+PARAMS_PATH = Path(__file__).parent / "data" / "les_params.npz"
+
+# meta-training configuration (kept here so the bundled artifact is
+# reproducible from the checked-in source alone)
+META_DIM = 8
+INNER_POP = 16
+INNER_GENS = 40
+TASKS_PER_GEN = 8
+OUTER_POP = 64
+OUTER_GENS = 1500
+OUTER_LR = 0.03
+OUTER_STD = 0.05
+
+
+def sample_task(key: jax.Array, dim: int) -> Dict[str, jax.Array]:
+    """One random task: family index + shift + rotation + conditioning."""
+    kt, ks, kr, ka = jax.random.split(key, 4)
+    rot, _ = jnp.linalg.qr(jax.random.normal(kr, (dim, dim)))
+    return {
+        "type": jax.random.randint(kt, (), 0, 4),
+        "shift": jax.random.uniform(ks, (dim,), minval=-2.0, maxval=2.0),
+        "rot": rot,
+        "alphas": 10.0 ** jax.random.uniform(ka, (dim,), minval=0.0, maxval=3.0),
+    }
+
+
+def task_eval(task: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    """Batched evaluation ``(pop, dim) -> (pop,)``; every family has its
+    optimum at 0 so the meta-score can compare log-gaps across families."""
+    y = (x - task["shift"]) @ task["rot"].T
+    dim = y.shape[-1]
+
+    def sphere(y):
+        return jnp.sum(y**2, axis=-1)
+
+    def ellipsoid(y):
+        return jnp.sum(task["alphas"] * y**2, axis=-1)
+
+    def rastrigin(y):
+        return 10.0 * dim + jnp.sum(
+            y**2 - 10.0 * jnp.cos(2.0 * math.pi * y), axis=-1
+        )
+
+    def rosenbrock(y):
+        z = y + 1.0
+        return jnp.sum(
+            100.0 * (z[..., 1:] - z[..., :-1] ** 2) ** 2
+            + (1.0 - z[..., :-1]) ** 2,
+            axis=-1,
+        )
+
+    return jax.lax.switch(
+        task["type"], [sphere, ellipsoid, rastrigin, rosenbrock], y
+    )
+
+
+def les_score(params, task, key, dim: int, pop: int, gens: int) -> jax.Array:
+    """log10 best-gap after running LES with ``params`` on ``task``."""
+    les = LES(jnp.zeros(dim), pop_size=pop, params=params)
+    state = les.init(key)
+
+    def gen(state, _):
+        cand, state = les.ask(state)
+        fit = task_eval(task, cand)
+        state = les.tell(state, fit)
+        return state, jnp.min(fit)
+
+    _, bests = jax.lax.scan(gen, state, length=gens)
+    return jnp.log10(jnp.min(bests) + 1e-10)
+
+
+def _template_params(pop: int, dim: int):
+    """A params pytree of the right structure (random init, seed 0)."""
+    return LES(jnp.zeros(dim), pop_size=pop, params=None).params
+
+
+def meta_train(
+    seed: int = 0,
+    outer_gens: int = OUTER_GENS,
+    progress_every: int = 0,
+) -> Tuple[Dict, jax.Array]:
+    """Run the outer OpenES; returns (best params pytree, flat vector)."""
+    from ....utils import rank_based_fitness
+
+    template = _template_params(INNER_POP, META_DIM)
+    flat0, unravel = ravel_pytree(template)
+
+    def meta_objective(flat, tasks, run_keys):
+        params = unravel(flat)
+        scores = jax.vmap(
+            lambda t, k: les_score(
+                params, t, k, META_DIM, INNER_POP, INNER_GENS
+            )
+        )(tasks, run_keys)
+        return jnp.mean(scores)
+
+    outer = OpenES(
+        flat0, OUTER_POP, learning_rate=OUTER_LR, noise_stdev=OUTER_STD
+    )
+    key = jax.random.PRNGKey(seed)
+    ostate = outer.init(key)
+
+    @jax.jit
+    def meta_step(ostate, key):
+        k_task, k_run = jax.random.split(key)
+        # common random numbers: every candidate sees the same tasks/seeds
+        tasks = jax.vmap(lambda k: sample_task(k, META_DIM))(
+            jax.random.split(k_task, TASKS_PER_GEN)
+        )
+        run_keys = jax.random.split(k_run, TASKS_PER_GEN)
+        cand, ostate = outer.ask(ostate)
+        fit = jax.vmap(lambda c: meta_objective(c, tasks, run_keys))(cand)
+        ostate = outer.tell(ostate, rank_based_fitness(fit))
+        return ostate, jnp.min(fit)
+
+    for g in range(outer_gens):
+        key, k = jax.random.split(key)
+        ostate, best = meta_step(ostate, k)
+        if progress_every and (g + 1) % progress_every == 0:
+            print(f"meta-gen {g + 1}/{outer_gens}: best mean log10-gap "
+                  f"{float(best):.3f}", flush=True)
+
+    flat = ostate.center
+    return unravel(flat), flat
+
+
+def save_params(flat: jax.Array, path: Path = PARAMS_PATH) -> None:
+    import numpy as np
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, flat=np.asarray(flat))
+
+
+def load_params(path: Path = PARAMS_PATH):
+    """Bundled params as a pytree, or None if no artifact exists."""
+    import numpy as np
+
+    if not Path(path).exists():
+        return None
+    flat = jnp.asarray(np.load(path)["flat"])
+    template = _template_params(INNER_POP, META_DIM)
+    flat0, unravel = ravel_pytree(template)
+    if flat.shape != flat0.shape:  # architecture drifted past the artifact
+        return None
+    return unravel(flat)
+
+
+if __name__ == "__main__":
+    params, flat = meta_train(progress_every=10)
+    save_params(flat)
+    print(f"saved {flat.shape[0]} params to {PARAMS_PATH}")

@@ -76,11 +76,14 @@ class Algorithm:
         ``IslandWorkflow`` — reference std_workflow.py:230-244).
 
         ``fitness`` is in the internal minimization convention. The default
-        replaces the worst rows of ``state.population`` / ``state.fitness``
-        — enough for every population-based single-objective state carrying
-        those two fields; algorithms with extra per-individual bookkeeping
-        (personal bests, archives) or multi-objective selection should
-        override.
+        offers each migrant to the worst rows of ``state.population`` /
+        ``state.fitness``, accepting only migrants that beat the row they
+        would displace (elitist acceptance — an unconditional overwrite
+        would let a bad migrant clobber e.g. a PSO pbest row and break its
+        monotonicity invariant). Enough for every population-based
+        single-objective state carrying those two fields; algorithms with
+        extra per-individual bookkeeping (personal bests, archives) or
+        multi-objective selection should override.
         """
         pop_arr = getattr(state, "population", None)
         fit_arr = getattr(state, "fitness", None)
@@ -91,7 +94,10 @@ class Algorithm:
             )
         k = fitness.shape[0]
         worst = jnp.argsort(-fit_arr)[:k]
+        accept = fitness < fit_arr[worst]  # (k,) per-row elitism
+        new_rows = jnp.where(accept[:, None], pop, pop_arr[worst])
+        new_fit = jnp.where(accept, fitness, fit_arr[worst])
         return state.replace(
-            population=pop_arr.at[worst].set(pop),
-            fitness=fit_arr.at[worst].set(fitness),
+            population=pop_arr.at[worst].set(new_rows),
+            fitness=fit_arr.at[worst].set(new_fit),
         )

@@ -21,9 +21,14 @@ def _checkpointer():
     """Process-wide orbax checkpointer (its save path is async-capable)."""
     global _ASYNC_CKPT
     if _ASYNC_CKPT is None:
+        import atexit
+
         import orbax.checkpoint as ocp
 
         _ASYNC_CKPT = ocp.StandardCheckpointer()
+        # a process exiting right after save(wait=False) must not leave a
+        # truncated/uncommitted checkpoint behind
+        atexit.register(wait_for_saves)
     return _ASYNC_CKPT
 
 

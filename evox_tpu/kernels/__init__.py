@@ -11,6 +11,7 @@ fallback stays the default.
 
 from .dominance import packed_dominance, packed_dominance_reference
 from .rollout import SoAEnv, fused_rollout, pendulum_soa
+from .rollout_mlp import PlaneEnv, chain_walker_planes, fused_mlp_rollout
 
 __all__ = [
     "packed_dominance",
@@ -18,4 +19,7 @@ __all__ = [
     "SoAEnv",
     "fused_rollout",
     "pendulum_soa",
+    "PlaneEnv",
+    "chain_walker_planes",
+    "fused_mlp_rollout",
 ]

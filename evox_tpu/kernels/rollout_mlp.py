@@ -1,0 +1,408 @@
+"""Fused big-policy rollout kernel (Pallas TPU): humanoid-scale episodes
+with the whole MLP resident in VMEM.
+
+The humanoid-scale workload (chain_walker: obs=244, act=17, 2-hidden MLP,
+dim≈21k) is HBM-bound on the standard scan engine: every env step re-reads
+every individual's ~84 KB of policy weights from HBM — ~4 bytes of weight
+traffic per 2 flops. The reference's engine shape (brax.py:62-97) has the
+same roofline; bench workload 2b measured ≈1.08x it.
+
+This kernel flips the roofline: a tile of 128 individuals' FULL weight
+matrices (~10.8 MB f32) is loaded into VMEM once per episode and reused
+across all T steps; env state lives as (component, tile) planes; each
+layer is a static loop of full-width (rows, 128) VPU fused
+multiply-adds (per-individual matvecs cannot use the MXU — every lane
+carries different weights). HBM sees one weight read and one fitness
+write per env per episode. Termination is a sticky in-kernel done mask
+over a fixed-T ``fori_loop`` (a while_loop with mixed-shape carries
+trips Mosaic layout inference; the masked form costs the
+post-termination steps but compiles everywhere).
+
+Layouts:
+- weights per layer ``(fan_in, fan_out, n)`` — individual in the lane
+  dimension, so ``w[k]`` is a ``(fan_out, tile)`` vreg block;
+- env state as a dict of ``(components, n)`` planes (:class:`PlaneEnv`);
+- observations assembled in-kernel as one ``(obs_dim, tile)`` block whose
+  row order matches the AoS env's observation vector exactly — the same
+  genome drives both engines bit-compatibly.
+
+``chain_walker_planes`` re-expresses control/walker.py's physics over
+planes; tests/test_kernels_mlp.py pins the kernel to the plane math
+exactly and to the scan engine's fitness within float tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+_LANES = 128
+
+PlaneState = Dict[str, jax.Array]
+
+
+class PlaneEnv(NamedTuple):
+    """An env in plane (component-major) form for the big-policy kernel.
+
+    ``base``: the AoS :class:`EnvSpec` (resets come from it — same draws
+    as the scan engine). ``to_planes``: batched AoS state ``(n, ...)`` ->
+    dict of ``(components, n)`` arrays. ``obs_planes``: plane state ->
+    ``(obs_dim, tile)`` observation block (row order == the AoS obs
+    vector). ``step_planes``: ``(state, act (act_dim, tile)) ->
+    (state, reward (1, tile), done (1, tile) bool)``.
+    """
+
+    base: Any
+    to_planes: Callable[[Any], PlaneState]
+    obs_planes: Callable[[PlaneState], jax.Array]
+    step_planes: Callable[
+        [PlaneState, jax.Array], Tuple[PlaneState, jax.Array, jax.Array]
+    ]
+
+
+# ------------------------------------------------------------ chain walker
+
+
+def chain_walker_planes(**kwargs) -> PlaneEnv:
+    """control/walker.py's chain_walker over (component, tile) planes.
+
+    Identical math to the AoS implementation (walker.py:_forces/obs/step),
+    with masses in the sublane dimension and individuals in lanes; the
+    ``.at[].add`` endpoint scatters become pad-and-add over the mass axis.
+    """
+    from ..problems.neuroevolution.control.walker import (
+        chain_walker,
+        walker_config,
+    )
+
+    cfg = walker_config(**kwargs)  # same constants as the AoS env, always
+    base = chain_walker(**cfg)
+    n_masses = cfg["n_masses"]
+    act_dim = cfg["act_dim"]
+    substeps = cfg["substeps"]
+    dt = cfg["dt"]
+    rod_length = cfg["rod_length"]
+    rod_stiffness = cfg["rod_stiffness"]
+    rod_damping = cfg["rod_damping"]
+    torque_scale = cfg["torque_scale"]
+    ground_stiffness = cfg["ground_stiffness"]
+    ground_damping = cfg["ground_damping"]
+    friction = cfg["friction"]
+    gravity = cfg["gravity"]
+    obs_dim = cfg["obs_dim"]
+    max_steps = cfg["max_steps"]
+    n_links = n_masses - 1
+    stand_height = 0.3 * n_links * rod_length
+    h = dt / substeps
+
+    def to_planes(state) -> PlaneState:
+        pos, vel, prev_a, t = state  # (n, 25, 2), (n, 25, 2), (n, 17), (n,)
+        return {
+            "px": pos[..., 0].T,  # (25, n)
+            "py": pos[..., 1].T,
+            "vx": vel[..., 0].T,
+            "vy": vel[..., 1].T,
+            "pa": prev_a.T,  # (17, n)
+            "t": t[None, :].astype(jnp.float32),  # (1, n)
+            "done": jnp.zeros((1, pos.shape[0]), dtype=jnp.float32),
+        }
+
+    def _pad_ends(f_link):
+        """(n_links, tile) per-link force -> per-mass sum: +f on the lower
+        endpoint, -f on the upper (walker.py's .at[:-1].add / .at[1:].add)."""
+        zero = jnp.zeros_like(f_link[:1])
+        return jnp.concatenate([f_link, zero], axis=0) - jnp.concatenate(
+            [zero, f_link], axis=0
+        )
+
+    def _forces(px, py, vx, vy, act):
+        fx = jnp.zeros_like(px)
+        fy = jnp.full_like(py, -gravity)
+
+        dx = px[1:] - px[:-1]
+        dy = py[1:] - py[:-1]
+        dist = jnp.sqrt(dx * dx + dy * dy + 1e-12)
+        ux, uy = dx / dist, dy / dist
+        rel_v = (vx[1:] - vx[:-1]) * ux + (vy[1:] - vy[:-1]) * uy
+        mag = rod_stiffness * (dist - rod_length) + rod_damping * rel_v
+        fx = fx + _pad_ends(mag * ux)
+        fy = fy + _pad_ends(mag * uy)
+
+        a = jnp.tanh(act) * torque_scale  # (act_dim, tile)
+        tq = jnp.concatenate(
+            [a, jnp.zeros((n_links - act_dim,) + a.shape[1:], a.dtype)], axis=0
+        )
+        coef = tq / jnp.maximum(dist, 1e-6)
+        fx = fx + _pad_ends(coef * -uy)
+        fy = fy + _pad_ends(coef * ux)
+
+        depth = jnp.maximum(-py, 0.0)
+        contact = (depth > 0.0).astype(px.dtype)
+        f_n = ground_stiffness * depth - ground_damping * vy * contact
+        f_n = jnp.maximum(f_n, 0.0) * contact
+        lim = jnp.abs(vx) * 50.0
+        f_t = -jnp.clip(friction * f_n * jnp.sign(vx), -lim, lim)
+        return fx + f_t, fy + f_n, f_n
+
+    def obs_planes(s: PlaneState) -> jax.Array:
+        px, py, vx, vy = s["px"], s["py"], s["vx"], s["vy"]
+        rel_x = px - px[:1]
+        rel_y = py - py[:1]
+        dx = px[1:] - px[:-1]
+        dy = py[1:] - py[:-1]
+        dist = jnp.sqrt(dx * dx + dy * dy + 1e-12)
+        strain = dist / rod_length - 1.0
+        ang_cos = dx / dist
+        ang_sin = dy / dist
+        rvx = vx[1:] - vx[:-1]
+        rvy = vy[1:] - vy[:-1]
+        ang_vel = (dx * rvy - dy * rvx) / (dist * dist)
+        _, _, f_n = _forces(px, py, vx, vy, s["pa"])
+        tile = px.shape[-1]
+        # interleave (m0x, m0y, m1x, ...) to match pos.reshape(-1)
+        rel = jnp.stack([rel_x, rel_y], axis=1).reshape(2 * n_masses, tile)
+        vel = jnp.stack([vx, vy], axis=1).reshape(2 * n_masses, tile)
+        parts = jnp.concatenate(
+            [
+                rel,
+                vel,
+                ang_cos,
+                ang_sin,
+                ang_vel,
+                strain,
+                f_n * 1e-2,
+                s["pa"],
+                py[:1],
+                py[-1:],
+                vx[:1],
+                vy[:1],
+            ],
+            axis=0,
+        )
+        k = parts.shape[0]
+        if k >= obs_dim:
+            return parts[:obs_dim]
+        return jnp.concatenate(
+            [parts, jnp.zeros((obs_dim - k, tile), parts.dtype)], axis=0
+        )
+
+    def step_planes(s: PlaneState, act: jax.Array):
+        px, py, vx, vy = s["px"], s["py"], s["vx"], s["vy"]
+
+        def substep(_, c):
+            px, py, vx, vy = c
+            fx, fy, _ = _forces(px, py, vx, vy, act)
+            vx = vx + h * fx
+            vy = vy + h * fy
+            return px + h * vx, py + h * vy, vx, vy
+
+        px, py, vx, vy = jax.lax.fori_loop(
+            0, substeps, substep, (px, py, vx, vy)
+        )
+        com_vx = jnp.mean(vx, axis=0, keepdims=True)  # (1, tile)
+        ta = jnp.tanh(act)
+        ctrl = 0.01 * jnp.sum(ta * ta, axis=0, keepdims=True)
+        reward = com_vx + 1.0 - ctrl
+        head_y = py[-1:]
+        fell = head_y < stand_height
+        mx = jnp.maximum(
+            jnp.max(jnp.abs(px), axis=0, keepdims=True),
+            jnp.max(jnp.abs(py), axis=0, keepdims=True),
+        )
+        exploded = ~(jnp.isfinite(mx)) | (mx > 1e3)
+        t = s["t"] + 1.0
+        done = fell | exploded | (t >= max_steps)
+        new = dict(s)
+        new.update(px=px, py=py, vx=vx, vy=vy, pa=act, t=t)
+        return new, reward, done
+
+    return PlaneEnv(
+        base=base,
+        to_planes=to_planes,
+        obs_planes=obs_planes,
+        step_planes=step_planes,
+    )
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes) -> jax.Array:
+    """(act_dim, tile) actions; per-individual matvecs as static loops of
+    full-width (fan_out, tile) FMAs (weights differ per lane -> no MXU)."""
+    h = obs
+    n_layers = len(sizes) - 1
+    for li in range(n_layers):
+        fan_in, fan_out = sizes[li], sizes[li + 1]
+        acc = b_refs[li][...]  # (fan_out, tile)
+        w = w_refs[li]
+        for k in range(fan_in):
+            acc = acc + h[k : k + 1] * w[k]
+        h = jnp.tanh(acc) if li < n_layers - 1 else acc
+    return h
+
+
+def _rollout_mlp_kernel(
+    refs,
+    out_ref,
+    *,
+    T: int,
+    sizes: Tuple[int, ...],
+    step_planes: Callable,
+    obs_planes: Callable,
+    state_keys: Tuple[str, ...],
+):
+    n_layers = len(sizes) - 1
+    w_refs = refs[:n_layers]
+    b_refs = refs[n_layers : 2 * n_layers]
+    state_refs = refs[2 * n_layers :]
+    # state blocks arrive (1, C, tile): drop the episode block dim
+    state = {k: r[0] for k, r in zip(state_keys, state_refs)}
+    tile = state[state_keys[0]].shape[-1]
+    total0 = jnp.zeros((1, tile), dtype=out_ref.dtype)
+    done0 = state.pop("done")  # (1, tile) float 0/1
+
+    # fixed trip count + sticky float done mask (an in-kernel while_loop
+    # with mixed-shape carries trips Mosaic layout inference; the masked
+    # fori costs the post-termination steps but compiles everywhere)
+    def body(_, carry):
+        state, done, total = carry
+        obs = obs_planes(state)
+        act = _mlp_planes(w_refs, b_refs, obs, sizes)
+        state, reward, step_done = step_planes(state, act)
+        total = total + jnp.where(done > 0.5, 0.0, reward)
+        done = jnp.maximum(done, step_done.astype(done.dtype))
+        return state, done, total
+
+    _, _, total = jax.lax.fori_loop(0, T, body, (state, done0, total0))
+    out_ref[...] = total
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "T", "sizes", "step_planes", "obs_planes", "tile", "episodes",
+        "interpret",
+    ),
+)
+def fused_mlp_rollout(
+    weights: Tuple[jax.Array, ...],
+    biases: Tuple[jax.Array, ...],
+    init_state: PlaneState,
+    T: int,
+    sizes: Tuple[int, ...],
+    step_planes: Callable,
+    obs_planes: Callable,
+    tile: int = _LANES,
+    episodes: int = 1,
+    interpret: bool = False,
+) -> jax.Array:
+    """Total episode reward per env, fully fused, weights VMEM-resident.
+
+    Args:
+        weights: per layer ``(fan_in, fan_out, n)`` (individual = lane).
+        biases: per layer ``(fan_out, n)``.
+        init_state: dict of ``(episodes * n,)``-env plane arrays, each
+            ``(C, episodes * n)``, EPISODE-MAJOR along the env axis. Must
+            contain a ``"done"`` plane (float 0/1) consumed as the initial
+            done mask.
+        T / sizes: horizon and MLP layer sizes (obs, h1, ..., act).
+        tile: individuals per grid cell (multiple of 128; default 128 —
+            the f32 VMEM budget for the default walker shape).
+
+    Returns:
+        ``(episodes * n,)`` total rewards, episode-major.
+    """
+    if not (_HAS_PLTPU or interpret):
+        raise RuntimeError(
+            "fused_mlp_rollout needs pallas TPU support (or interpret=True)"
+        )
+    if tile % _LANES != 0:
+        raise ValueError(f"tile must be a multiple of {_LANES}, got {tile}")
+    n_layers = len(sizes) - 1
+    assert len(weights) == n_layers and len(biases) == n_layers
+    n = weights[0].shape[-1]
+    pad = (-n) % tile
+    n_pad = n + pad
+    if pad:
+        weights = tuple(
+            jnp.pad(w, ((0, 0), (0, 0), (0, pad))) for w in weights
+        )
+        biases = tuple(jnp.pad(b, ((0, 0), (0, pad))) for b in biases)
+        init_state = {
+            k: jnp.pad(
+                v.reshape(v.shape[0], episodes, n), ((0, 0), (0, 0), (0, pad))
+            ).reshape(v.shape[0], episodes * n_pad)
+            for k, v in init_state.items()
+        }
+        # padded envs must not keep the while_loop alive
+        d = init_state["done"].reshape(1, episodes, n_pad)
+        init_state["done"] = d.at[:, :, n:].set(1.0).reshape(1, episodes * n_pad)
+    state_3d = {
+        k: v.reshape(v.shape[0], episodes, n_pad).transpose(1, 0, 2)
+        for k, v in sorted(init_state.items())
+    }  # (episodes, C, n_pad)
+    state_keys = tuple(state_3d)
+    blocks = n_pad // tile
+
+    kernel = functools.partial(
+        _rollout_mlp_kernel,
+        T=T,
+        sizes=sizes,
+        step_planes=step_planes,
+        obs_planes=obs_planes,
+        state_keys=state_keys,
+    )
+
+    def wrapped(*refs):
+        kernel(refs[:-1], refs[-1])
+
+    w_specs = [
+        pl.BlockSpec(
+            (w.shape[0], w.shape[1], tile), lambda e, b: (0, 0, b)
+        )
+        for w in weights
+    ]
+    b_specs = [
+        pl.BlockSpec((b.shape[0], tile), lambda e, b: (0, b)) for b in biases
+    ]
+    s_specs = [
+        pl.BlockSpec(
+            (1, state_3d[k].shape[1], tile), lambda e, b: (e, 0, b)
+        )
+        for k in state_keys
+    ]
+    kwargs = {}
+    if not interpret and _HAS_PLTPU:
+        # the weight blocks are double-buffered across grid cells; the
+        # default 16 MB scoped-vmem budget is too small for the resident
+        # weights — raise it (v5e VMEM is far larger than the default cap)
+        from jax.experimental.pallas import tpu as pltpu
+
+        per_cell = sum(
+            w.shape[0] * w.shape[1] * tile * 4 for w in weights
+        ) + sum(b.shape[0] * tile * 4 for b in biases)
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=min(2 * per_cell + 8 * 1024 * 1024, 100 * 2**20)
+        )
+    total = pl.pallas_call(
+        wrapped,
+        grid=(episodes, blocks),
+        in_specs=w_specs + b_specs + s_specs,
+        out_specs=pl.BlockSpec((1, tile), lambda e, b: (e, b)),
+        out_shape=jax.ShapeDtypeStruct((episodes, n_pad), weights[0].dtype),
+        interpret=interpret,
+        **kwargs,
+    )(*weights, *biases, *state_3d.values())
+    return total[:, :n].reshape(episodes * n)

@@ -1,4 +1,4 @@
 from .regression import GPRegression
-from .classification import GPClassification
+from .classification import GPClassification, ProbitLabelRegression
 
-__all__ = ["GPRegression", "GPClassification"]
+__all__ = ["GPRegression", "GPClassification", "ProbitLabelRegression"]

@@ -1,21 +1,168 @@
-"""GP binary classification via the Laplace-free logistic approximation:
-GP regression on {-1, +1} labels squashed through a probit link at predict
-time (Nickisch & Rasmussen's "label regression" baseline). Capability parity
-with reference src/evox/operators/gaussian_process/classification.py:16+
-(gpjax Bernoulli likelihood) at the fidelity the framework uses it.
+"""GP binary classification with a Bernoulli likelihood (Laplace
+approximation) in pure JAX.
+
+Capability parity with reference src/evox/operators/gaussian_process/
+classification.py:16+ (gpjax Bernoulli likelihood + posterior inference;
+gpjax is not in this build). :class:`GPClassification` implements the
+standard Laplace scheme (Rasmussen & Williams 2006, Algorithms 3.1/3.2):
+Newton iterations for the posterior mode of the latent function under a
+logistic likelihood, predictive variance through the usual
+``B = I + W^1/2 K W^1/2`` Cholesky, and MacKay's probit squashing of the
+latent predictive for calibrated probabilities. Hyperparameters are
+optionally optimized against the Laplace approximate marginal likelihood
+by differentiating through the (fixed-iteration) Newton solve.
+
+:class:`ProbitLabelRegression` keeps the previous label-regression
+shortcut (GP regression on ±1 labels + probit squash) as the cheap
+baseline — tests/test_gaussian_process.py shows the Bernoulli version's
+probabilities are better calibrated.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import optax
 
-from .regression import GPRegression
+from .regression import GPParams, GPRegression, _rbf
 
 
-class GPClassification(GPRegression):
+class LaplaceModel(NamedTuple):
+    params: GPParams
+    x: jax.Array  # (n, d) training inputs
+    y: jax.Array  # (n,) labels in {-1, +1}
+    f_hat: jax.Array  # (n,) latent posterior mode
+
+
+def _newton_mode(
+    params: GPParams, x: jax.Array, y: jax.Array, steps: int
+) -> jax.Array:
+    """Posterior mode of the latent f (R&W Algorithm 3.1, fixed trip
+    count so it jits and autodiffs)."""
+    n = x.shape[0]
+    K = _rbf(x, x, params) + 1e-6 * jnp.eye(n)
+    t = (y + 1.0) / 2.0
+
+    def step(f, _):
+        pi = jax.nn.sigmoid(f)
+        grad = t - pi
+        W = jnp.clip(pi * (1.0 - pi), 1e-10)
+        sW = jnp.sqrt(W)
+        B = jnp.eye(n) + sW[:, None] * K * sW[None, :]
+        L = jnp.linalg.cholesky(B)
+        b = W * f + grad
+        a = b - sW * jax.scipy.linalg.cho_solve((L, True), sW * (K @ b))
+        return K @ a, None
+
+    f_hat, _ = jax.lax.scan(step, jnp.zeros(n), length=steps)
+    return f_hat
+
+
+def _laplace_neg_evidence(
+    params: GPParams, x: jax.Array, y: jax.Array, steps: int
+) -> jax.Array:
+    """-log q(y | X, theta) under the Laplace approximation (R&W 3.32)."""
+    n = x.shape[0]
+    f_hat = _newton_mode(params, x, y, steps)
+    K = _rbf(x, x, params) + 1e-6 * jnp.eye(n)
+    t = (y + 1.0) / 2.0
+    pi = jax.nn.sigmoid(f_hat)
+    W = jnp.clip(pi * (1.0 - pi), 1e-10)
+    sW = jnp.sqrt(W)
+    B = jnp.eye(n) + sW[:, None] * K * sW[None, :]
+    L = jnp.linalg.cholesky(B)
+    # at the mode K a = f_hat with a = grad log p(y|f) = t - pi — closed
+    # form, no K solve needed (K with only 1e-6 jitter can be near-singular)
+    a = t - pi
+    log_lik = jnp.sum(jax.nn.log_sigmoid(y * f_hat))
+    return 0.5 * f_hat @ a - log_lik + jnp.sum(jnp.log(jnp.diagonal(L)))
+
+
+class GPClassification:
+    """Laplace-Bernoulli GP classifier: ``fit(x, y)`` with labels in
+    {0, 1} or {-1, +1}, then ``predict_proba`` / ``predict_label``.
+
+    ``fit_steps > 0`` additionally optimizes (lengthscale, variance) by
+    the approximate marginal likelihood (adam, grads through the Newton
+    solve)."""
+
+    def __init__(
+        self,
+        lengthscale: float = 1.0,
+        variance: float = 1.0,
+        newton_steps: int = 15,
+        fit_steps: int = 0,
+        learning_rate: float = 0.1,
+    ):
+        self.init_params = GPParams(
+            log_lengthscale=jnp.log(jnp.asarray(lengthscale)),
+            log_variance=jnp.log(jnp.asarray(variance)),
+            log_noise=jnp.log(jnp.asarray(1e-6)),  # unused by the likelihood
+        )
+        self.newton_steps = newton_steps
+        self.fit_steps = fit_steps
+        self.opt = optax.adam(learning_rate)
+
+    def fit(self, x: jax.Array, y: jax.Array) -> LaplaceModel:
+        x = GPRegression._shape(x)
+        y = jnp.where(y > 0, 1.0, -1.0)
+        params = self.init_params
+        if self.fit_steps > 0:
+
+            def opt_step(carry, _):
+                params, opt_state = carry
+                loss, g = jax.value_and_grad(_laplace_neg_evidence)(
+                    params, x, y, self.newton_steps
+                )
+                updates, opt_state = self.opt.update(g, opt_state)
+                params = jax.tree.map(lambda p, u: p + u, params, updates)
+                return (params, opt_state), loss
+
+            (params, _), _ = jax.lax.scan(
+                opt_step, (params, self.opt.init(params)), length=self.fit_steps
+            )
+        f_hat = _newton_mode(params, x, y, self.newton_steps)
+        return LaplaceModel(params=params, x=x, y=y, f_hat=f_hat)
+
+    def latent(self, model: LaplaceModel, x_test: jax.Array):
+        """Latent predictive ``(mean, var)`` at ``x_test`` (R&W Alg 3.2)."""
+        params, x, y, f_hat = model
+        x_test = GPRegression._shape(x_test)
+        n = x.shape[0]
+        K = _rbf(x, x, params) + 1e-6 * jnp.eye(n)
+        pi = jax.nn.sigmoid(f_hat)
+        t = (y + 1.0) / 2.0
+        W = jnp.clip(pi * (1.0 - pi), 1e-10)
+        sW = jnp.sqrt(W)
+        B = jnp.eye(n) + sW[:, None] * K * sW[None, :]
+        L = jnp.linalg.cholesky(B)
+        Ks = _rbf(x_test, x, params)  # (m, n)
+        mean = Ks @ (t - pi)
+        v = jax.scipy.linalg.solve_triangular(
+            L, sW[:, None] * Ks.T, lower=True
+        )
+        var = jnp.clip(
+            jnp.exp(params.log_variance) - jnp.sum(v**2, axis=0), 1e-12
+        )
+        return mean, var
+
+    def predict_proba(self, model: LaplaceModel, x_test: jax.Array) -> jax.Array:
+        mean, var = self.latent(model, x_test)
+        # MacKay's approximation of the logistic-Gaussian integral
+        kappa = 1.0 / jnp.sqrt(1.0 + jnp.pi * var / 8.0)
+        return jax.nn.sigmoid(kappa * mean)
+
+    def predict_label(self, model: LaplaceModel, x_test: jax.Array) -> jax.Array:
+        return (self.predict_proba(model, x_test) > 0.5).astype(jnp.int32)
+
+
+class ProbitLabelRegression(GPRegression):
+    """The previous cheap approximation (kept as a baseline): GP
+    regression on ±1 labels, probit-squashed at predict time (Nickisch &
+    Rasmussen's "label regression")."""
+
     def fit(self, x: jax.Array, y: jax.Array):
         """``y`` in {0, 1} or {-1, +1}."""
         y = jnp.where(y > 0, 1.0, -1.0)
@@ -23,7 +170,6 @@ class GPClassification(GPRegression):
 
     def predict_proba(self, model, x_test: jax.Array) -> jax.Array:
         mean, var = super().predict(model, x_test)
-        # probit-squashed latent (accounts for predictive variance)
         return jax.scipy.stats.norm.cdf(mean / jnp.sqrt(1.0 + var))
 
     def predict_label(self, model, x_test: jax.Array) -> jax.Array:

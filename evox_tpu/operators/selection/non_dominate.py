@@ -186,3 +186,18 @@ class NonDominate:
 
     def __call__(self, pop, fitness):
         return non_dominate(pop, fitness, self.topk, self.deduplicate)
+
+
+def rank_crowding_truncate(
+    fitness: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array]:
+    """NSGA-II environmental truncation: the ``k`` survivors of ``fitness``
+    ``(n, m)`` by (Pareto rank asc, crowding distance desc on the cut
+    front). Returns ``(order, ranks)`` — survivor indices into ``fitness``
+    and their ranks. Shared by NSGA-II's ``tell`` and the GA-skeleton
+    MOEAs' migration ingest (one source of truth for the truncation)."""
+    rank = non_dominated_sort(fitness, until=k)
+    worst_rank = jnp.sort(rank)[k - 1]
+    crowd = crowding_distance(fitness, mask=rank == worst_rank)
+    order = jnp.lexsort((-crowd, rank))[:k]
+    return order, rank[order]

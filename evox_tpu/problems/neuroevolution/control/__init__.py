@@ -1,3 +1,6 @@
 from . import envs
 from .envs import EnvSpec, acrobot, cartpole, make, mountain_car, pendulum
 from .brax_adapter import brax_env
+from .walker import chain_walker
+
+envs.ENVS["chain_walker"] = chain_walker  # available through make()

@@ -1,0 +1,207 @@
+"""Humanoid-scale pure-JAX locomotion environment.
+
+The north-star neuroevolution workload (BASELINE.md, reference
+src/evox/problems/neuroevolution/reinforcement_learning/brax.py:45-97) is
+OpenES driving a Brax *Humanoid* policy: observations ~244, actions 17,
+contact physics, episode termination on falling. Brax is not part of this
+build, so this module provides that workload shape natively: an
+articulated planar chain of point masses — stiff rod springs for limbs,
+actuated joint torques, gravity, and spring-damper ground **contact with
+Coulomb-style friction** — integrated semi-implicitly with substeps.
+
+It is a real (if planar) rigid-body-style simulation, not a synthetic
+FLOP burner: policies must learn to push against ground contact to move
+the chain's center of mass forward, falling terminates the episode, and
+the reward is forward progress + alive bonus - control cost, mirroring
+the Humanoid reward structure.
+
+The default configuration matches Humanoid's interface numbers exactly:
+``obs_dim=244``, ``act_dim=17``. Everything is `vmap`/`jit` friendly and
+runs on the standard :class:`PolicyRolloutProblem` engines; under the
+workflow mesh the population axis shards across chips like every other
+rollout workload (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .envs import EnvSpec
+
+
+# single source of truth for the physics constants — chain_walker_planes
+# (kernels/rollout_mlp.py) re-derives the SAME configuration from this
+# dict, so the two engines cannot drift
+WALKER_DEFAULTS = dict(
+    n_masses=25,
+    act_dim=17,
+    max_steps=1000,
+    substeps=5,
+    dt=0.01,
+    rod_length=0.2,
+    rod_stiffness=2000.0,
+    rod_damping=4.0,
+    torque_scale=8.0,
+    ground_stiffness=3000.0,
+    ground_damping=10.0,
+    friction=1.0,
+    gravity=9.8,
+    obs_dim=244,
+)
+
+
+def walker_config(**overrides) -> dict:
+    """WALKER_DEFAULTS merged with ``overrides`` (unknown keys rejected)."""
+    unknown = set(overrides) - set(WALKER_DEFAULTS)
+    if unknown:
+        raise TypeError(f"unknown chain_walker parameters: {sorted(unknown)}")
+    return {**WALKER_DEFAULTS, **overrides}
+
+
+def chain_walker(
+    n_masses: int = WALKER_DEFAULTS["n_masses"],
+    act_dim: int = WALKER_DEFAULTS["act_dim"],
+    max_steps: int = WALKER_DEFAULTS["max_steps"],
+    substeps: int = WALKER_DEFAULTS["substeps"],
+    dt: float = WALKER_DEFAULTS["dt"],
+    rod_length: float = WALKER_DEFAULTS["rod_length"],
+    rod_stiffness: float = WALKER_DEFAULTS["rod_stiffness"],
+    rod_damping: float = WALKER_DEFAULTS["rod_damping"],
+    torque_scale: float = WALKER_DEFAULTS["torque_scale"],
+    ground_stiffness: float = WALKER_DEFAULTS["ground_stiffness"],
+    ground_damping: float = WALKER_DEFAULTS["ground_damping"],
+    friction: float = WALKER_DEFAULTS["friction"],
+    gravity: float = WALKER_DEFAULTS["gravity"],
+    obs_dim: int = WALKER_DEFAULTS["obs_dim"],
+) -> EnvSpec:
+    """Planar articulated chain with ground contact (Humanoid-shaped).
+
+    State: ``(pos (n,2), vel (n,2), prev_action (act_dim,), t ())``.
+    The chain starts standing upright-ish (a folded zig-zag over the
+    origin); actuators apply torque pairs about the first ``act_dim``
+    interior joints. Termination when the head (last mass) drops below
+    ``0.3 * n_links * rod_length`` — the "fell over" condition.
+
+    Observation (root-relative, ``obs_dim`` wide): mass positions and
+    velocities, link angle sin/cos and angular speed, per-mass contact
+    normal force, rod strain, previous action, and global root
+    height/velocity — zero-padded or truncated to exactly ``obs_dim`` so
+    the policy interface stays fixed while ``n_masses`` varies.
+    """
+    n_links = n_masses - 1
+    if act_dim > n_links - 1:
+        raise ValueError(
+            f"act_dim={act_dim} needs at least {act_dim + 1} links "
+            f"({act_dim + 2} masses)"
+        )
+    stand_height = 0.3 * n_links * rod_length
+    h = dt / substeps
+
+    def _init_pos() -> jax.Array:
+        # a standing zig-zag: alternate small x offsets, stacked in y
+        idx = jnp.arange(n_masses, dtype=jnp.float32)
+        zig = 0.3 * rod_length * jnp.where(idx % 2 == 0, 1.0, -1.0)
+        y = 0.02 + idx * rod_length * jnp.sqrt(1.0 - 0.09)
+        return jnp.stack([zig, y], axis=-1)  # (n, 2)
+
+    base_pos = _init_pos()
+
+    def _forces(pos: jax.Array, vel: jax.Array, action: jax.Array):
+        """Total force on each mass + per-mass contact normal force."""
+        f = jnp.zeros_like(pos).at[:, 1].add(-gravity)
+
+        # rod springs: keep consecutive masses at rod_length
+        d = pos[1:] - pos[:-1]  # (n_links, 2)
+        dist = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
+        u = d / dist[:, None]
+        rel_v = jnp.sum((vel[1:] - vel[:-1]) * u, axis=-1)
+        mag = rod_stiffness * (dist - rod_length) + rod_damping * rel_v
+        f_rod = mag[:, None] * u  # pulls endpoints together when stretched
+        f = f.at[:-1].add(f_rod).at[1:].add(-f_rod)
+
+        # joint torques: actuator j applies equal-and-opposite tangential
+        # forces to the masses flanking interior joint j+1
+        act = jnp.tanh(action) * torque_scale
+        perp = jnp.stack([-u[:, 1], u[:, 0]], axis=-1)  # (n_links, 2)
+        tq = jnp.zeros(n_links).at[:act_dim].set(act)
+        f_tq = (tq / jnp.maximum(dist, 1e-6))[:, None] * perp
+        f = f.at[:-1].add(f_tq).at[1:].add(-f_tq)
+
+        # ground contact: spring-damper normal force + Coulomb-ish friction
+        depth = jnp.maximum(-pos[:, 1], 0.0)
+        contact = depth > 0.0
+        f_n = ground_stiffness * depth - ground_damping * vel[:, 1] * contact
+        f_n = jnp.maximum(f_n, 0.0) * contact
+        f_t = -jnp.clip(
+            friction * f_n * jnp.sign(vel[:, 0]),
+            -jnp.abs(vel[:, 0]) * 50.0,
+            jnp.abs(vel[:, 0]) * 50.0,
+        )
+        f = f.at[:, 1].add(f_n).at[:, 0].add(f_t)
+        return f, f_n
+
+    def reset(key: jax.Array):
+        k1, k2 = jax.random.split(key)
+        pos = base_pos + 0.01 * jax.random.normal(k1, base_pos.shape)
+        vel = 0.01 * jax.random.normal(k2, base_pos.shape)
+        return (pos, vel, jnp.zeros(act_dim), jnp.zeros((), jnp.int32))
+
+    def obs(state) -> jax.Array:
+        pos, vel, prev_a, _ = state
+        root = pos[0]
+        rel = pos - root  # root-relative positions
+        d = pos[1:] - pos[:-1]
+        dist = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
+        strain = dist / rod_length - 1.0
+        ang_cos = d[:, 0] / dist
+        ang_sin = d[:, 1] / dist
+        rel_v = vel[1:] - vel[:-1]
+        ang_vel = (d[:, 0] * rel_v[:, 1] - d[:, 1] * rel_v[:, 0]) / (dist * dist)
+        _, f_n = _forces(pos, vel, prev_a)
+        parts = jnp.concatenate(
+            [
+                rel.reshape(-1),  # 2n
+                vel.reshape(-1),  # 2n
+                ang_cos,  # n-1
+                ang_sin,  # n-1
+                ang_vel,  # n-1
+                strain,  # n-1
+                f_n * 1e-2,  # n  (scaled into O(1))
+                prev_a,  # act_dim
+                jnp.stack([pos[0, 1], pos[-1, 1], vel[0, 0], vel[0, 1]]),
+            ]
+        )
+        k = parts.shape[0]
+        if k >= obs_dim:
+            return parts[:obs_dim]
+        return jnp.concatenate([parts, jnp.zeros(obs_dim - k)])
+
+    def step(state, action: jax.Array):
+        pos, vel, _, t = state
+
+        def substep(_, pv):
+            p, v = pv
+            f, _ = _forces(p, v, action)
+            v = v + h * f  # unit masses; semi-implicit Euler
+            return p + h * v, v
+
+        pos, vel = jax.lax.fori_loop(0, substeps, substep, (pos, vel))
+        com_vx = jnp.mean(vel[:, 0])
+        ctrl_cost = 0.01 * jnp.sum(jnp.tanh(action) ** 2)
+        head_y = pos[-1, 1]
+        fell = head_y < stand_height
+        exploded = jnp.any(~jnp.isfinite(pos)) | (jnp.max(jnp.abs(pos)) > 1e3)
+        reward = com_vx + 1.0 - ctrl_cost
+        done = fell | exploded | (t + 1 >= max_steps)
+        return (pos, vel, action, t + 1), reward, done
+
+    return EnvSpec(
+        reset=reset,
+        obs=obs,
+        step=step,
+        obs_dim=obs_dim,
+        act_dim=act_dim,
+        discrete=False,
+        max_steps=max_steps,
+    )

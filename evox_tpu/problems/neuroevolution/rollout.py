@@ -171,6 +171,17 @@ class PolicyRolloutProblem(Problem):
             2048 measured best on v5e — PERF_NOTES §8).
         fused_interpret: run the kernel in interpreter mode (None = auto:
             interpret on the CPU backend, compiled elsewhere).
+        fused_planes: a :class:`~evox_tpu.kernels.rollout_mlp.PlaneEnv` —
+            switches ``evaluate`` to the BIG-POLICY fused kernel
+            (:func:`~evox_tpu.kernels.rollout_mlp.fused_mlp_rollout`):
+            a tile of individuals' full MLP weights stays resident in
+            VMEM across the whole episode, with per-tile early exit on
+            termination. Population must be an ``mlp_policy`` params
+            tree (pass the ``TreeAndVector`` adapter's ``batched_to_tree``
+            as a workflow pop transform, as usual). For humanoid-scale
+            policies where per-step weight re-reads dominate
+            (PERF_NOTES §9).
+        fused_planes_tile: individuals per grid cell (multiple of 128).
     """
 
     def __init__(
@@ -188,6 +199,8 @@ class PolicyRolloutProblem(Problem):
         fused_env: Optional["SoAEnv"] = None,
         fused_tile: int = 2048,
         fused_interpret: Optional[bool] = None,
+        fused_planes: Optional["PlaneEnv"] = None,
+        fused_planes_tile: int = 128,
     ):
         self.policy = policy
         self.env = env
@@ -212,9 +225,19 @@ class PolicyRolloutProblem(Problem):
                     "fused_env cannot be combined with cap_episode or "
                     "obs_normalizer"
                 )
+        if fused_planes is not None:
+            if fused_env is not None:
+                raise ValueError("pass fused_env OR fused_planes, not both")
+            if cap_episode is not None or obs_normalizer is not None:
+                raise ValueError(
+                    "fused_planes cannot be combined with cap_episode or "
+                    "obs_normalizer"
+                )
         self.fused_env = fused_env
         self.fused_tile = fused_tile
         self.fused_interpret = fused_interpret
+        self.fused_planes = fused_planes
+        self.fused_planes_tile = fused_planes_tile
         self._fused_policy_checked = False
 
     def _check_fused_policy(self, dim: int, hidden: int) -> None:
@@ -227,14 +250,18 @@ class PolicyRolloutProblem(Problem):
 
         obs_dim, act_dim = self.env.obs_dim, self.env.act_dim
         rng = np.random.default_rng(0)
-        theta = jnp.asarray(rng.normal(size=(dim,)), dtype=jnp.float32)
-        obs = jnp.asarray(rng.normal(size=(obs_dim,)), dtype=jnp.float32)
-        want = _mlp_act(
-            theta[:, None], tuple(obs[k : k + 1] for k in range(obs_dim)),
-            obs_dim, hidden, act_dim,
-        )
-        want = np.asarray(jnp.concatenate(want))
-        got = np.asarray(self.policy(theta, obs)).reshape(-1)
+        # evaluate has usually been jit-traced by the workflow at this
+        # point; the probe must still produce CONCRETE values, so force
+        # compile-time evaluation of this constant-only computation
+        with jax.ensure_compile_time_eval():
+            theta = jnp.asarray(rng.normal(size=(dim,)), dtype=jnp.float32)
+            obs = jnp.asarray(rng.normal(size=(obs_dim,)), dtype=jnp.float32)
+            want = _mlp_act(
+                theta[:, None], tuple(obs[k : k + 1] for k in range(obs_dim)),
+                obs_dim, hidden, act_dim,
+            )
+            want = np.asarray(jnp.concatenate(want))
+            got = np.asarray(self.policy(theta, obs)).reshape(-1)
         if got.shape != want.shape or not np.allclose(got, want, atol=1e-5):
             raise ValueError(
                 "fused_env requires the policy to be the flat tanh MLP the "
@@ -310,7 +337,109 @@ class PolicyRolloutProblem(Problem):
         fitness = self.reduce_fn(totals.reshape(ep, pop_size).T, axis=-1)
         return fitness, RolloutState(key=key, cap=state.cap, norm=state.norm)
 
+    def _evaluate_fused_planes(
+        self, state: RolloutState, pop: Any
+    ) -> Tuple[jax.Array, RolloutState]:
+        """Big-policy kernel engine (kernels/rollout_mlp.py): whole MLP
+        resident in VMEM, per-tile early exit. ``pop`` must be an
+        ``mlp_policy`` params tree (list of {"w", "b"} layers, batched on
+        the leading axis)."""
+        from ...kernels.rollout_mlp import fused_mlp_rollout
+
+        key = state.key
+        if self.stochastic_reset:
+            key, k_eps = jax.random.split(key)
+        else:
+            k_eps = jax.random.fold_in(key, 0)
+        if not (
+            isinstance(pop, (list, tuple))
+            and all(isinstance(l, dict) and {"w", "b"} <= set(l) for l in pop)
+        ):
+            raise ValueError(
+                "fused_planes expects an mlp_policy params tree "
+                "(list of {'w', 'b'} layers)"
+            )
+        weights = tuple(l["w"].transpose(1, 2, 0) for l in pop)  # (in, out, n)
+        biases = tuple(l["b"].T for l in pop)  # (out, n)
+        sizes = (weights[0].shape[0],) + tuple(w.shape[1] for w in weights)
+        if sizes[0] != self.env.obs_dim or sizes[-1] != self.env.act_dim:
+            raise ValueError(
+                f"policy sizes {sizes} do not match env "
+                f"({self.env.obs_dim} -> {self.env.act_dim})"
+            )
+        if not self._fused_policy_checked:
+            self._check_fused_planes_policy(pop, sizes)
+        pop_size = pop[0]["b"].shape[0]
+        ep = self.num_episodes
+
+        ep_keys = jax.random.split(k_eps, ep)
+        env_state0 = jax.vmap(self.fused_planes.base.reset)(ep_keys)
+        env_flat = jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                x[:, None], (ep, pop_size) + x.shape[1:]
+            ).reshape((ep * pop_size,) + x.shape[1:]),
+            env_state0,
+        )
+        planes0 = self.fused_planes.to_planes(env_flat)
+        interpret = self.fused_interpret
+        if interpret is None:
+            interpret = jax.default_backend() == "cpu"
+        totals = fused_mlp_rollout(
+            weights,
+            biases,
+            planes0,
+            T=int(self.max_len),
+            sizes=sizes,
+            step_planes=self.fused_planes.step_planes,
+            obs_planes=self.fused_planes.obs_planes,
+            tile=self.fused_planes_tile,
+            episodes=ep,
+            interpret=interpret,
+        )
+        fitness = self.reduce_fn(totals.reshape(ep, pop_size).T, axis=-1)
+        return fitness, RolloutState(key=key, cap=state.cap, norm=state.norm)
+
+    def _check_fused_planes_policy(self, pop: Any, sizes) -> None:
+        """One-time concrete probe: ``self.policy`` must agree with the
+        kernel's tanh-MLP plane math on the params tree layout."""
+        import numpy as np
+
+        from ...kernels.rollout_mlp import _mlp_planes
+
+        rng = np.random.default_rng(0)
+        with jax.ensure_compile_time_eval():
+            params = [
+                {
+                    "w": jnp.asarray(
+                        rng.normal(size=(sizes[i], sizes[i + 1])) * 0.3,
+                        dtype=jnp.float32,
+                    ),
+                    "b": jnp.asarray(
+                        rng.normal(size=(sizes[i + 1],)), dtype=jnp.float32
+                    ),
+                }
+                for i in range(len(sizes) - 1)
+            ]
+            obs = jnp.asarray(rng.normal(size=(sizes[0],)), dtype=jnp.float32)
+            w_refs = [l["w"][:, :, None] for l in params]  # (in, out, 1)
+            b_refs = [l["b"][:, None] for l in params]  # (out, 1)
+            want = np.asarray(
+                _mlp_planes(w_refs, b_refs, obs[:, None], tuple(sizes))
+            ).reshape(-1)
+            got = np.asarray(self.policy(params, obs)).reshape(-1)
+        if got.shape != want.shape or not np.allclose(
+            got, want, atol=1e-4, rtol=1e-4
+        ):
+            raise ValueError(
+                "fused_planes requires the policy to be the tanh MLP the "
+                "kernel implements (use mlp_policy); the supplied policy "
+                "disagrees with the kernel math on a probe input"
+            )
+        self._fused_policy_checked = True
+
     def evaluate(self, state: RolloutState, pop: Any) -> Tuple[jax.Array, RolloutState]:
+        if self.fused_planes is not None:
+            return self._evaluate_fused_planes(state, pop)
         if self.fused_env is not None:
             return self._evaluate_fused(state, pop)
         key = state.key

@@ -208,3 +208,42 @@ class HostRolloutFarm(Problem):
             np.concatenate(mo),
             np.concatenate(lengths),
         )
+
+    def visualize(
+        self,
+        params: Any,
+        seed: int = 0,
+        max_steps: Optional[int] = None,
+        env_creator: Optional[Callable] = None,
+        render: bool = True,
+    ) -> Tuple[list, np.ndarray]:
+        """Roll out ONE policy and collect the env's rendered frames.
+
+        The host-env analog of the reference's ``visualize`` (reference
+        gym.py:383-426: reset one env, step the trained policy, collect
+        ``env.render()`` output per step). Returns ``(frames, rewards)``;
+        pipe ``frames`` into :func:`evox_tpu.utils.frames2gif`. With
+        ``render=False`` (or an env whose ``render`` returns None) the
+        frames list carries the raw observations instead — still enough
+        for trajectory plots. ``env_creator`` overrides the farm's own
+        (pass one that sets ``render_mode="rgb_array"`` if the training
+        envs were created headless).
+        """
+        env = (env_creator or self.workers[0].env_creator)()
+        policy = jax.jit(self.policy)
+        obs, _ = env.reset(seed=seed)
+        frames: list = []
+        rewards: list = []
+        cap = max_steps if max_steps is not None else (self.cap or 10_000)
+        can_render = render and hasattr(env, "render")
+        for _ in range(cap):
+            frame = env.render() if can_render else None
+            frames.append(np.asarray(frame) if frame is not None else np.asarray(obs))
+            action = np.asarray(
+                policy(params, jnp.asarray(obs, dtype=jnp.float32))
+            )
+            obs, reward, terminated, truncated, _ = env.step(action)
+            rewards.append(float(reward))
+            if terminated or truncated:
+                break
+        return frames, np.asarray(rewards)

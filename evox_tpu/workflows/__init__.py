@@ -1,9 +1,11 @@
 from .std import StdWorkflow, StdWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
+from .pipelined import run_host_pipelined
 
 __all__ = [
     "StdWorkflow",
     "StdWorkflowState",
     "IslandWorkflow",
     "IslandWorkflowState",
+    "run_host_pipelined",
 ]

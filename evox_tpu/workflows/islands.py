@@ -82,7 +82,13 @@ class IslandWorkflow:
             over its ``"pop"`` axis (``n_islands`` must divide evenly).
         external_problem: route evaluation through ``jax.pure_callback``
             (host problems), same contract as :class:`StdWorkflow`.
-        num_objectives: callback fitness arity (migration requires 1).
+        num_objectives: fitness arity. For ``> 1`` the workflow is
+            multi-objective: migration elites are chosen per island by
+            non-dominated rank + crowding distance and ingested through
+            the algorithm's MO ``migrate`` (GA-skeleton MOEAs merge
+            migrants into their (rank, crowding) environmental
+            selection — :meth:`~evox_tpu.algorithms.mo.common.
+            GAMOAlgorithm.migrate`).
         jit_step: disable to debug eagerly.
     """
 
@@ -106,11 +112,8 @@ class IslandWorkflow:
             raise ValueError(f"need at least 2 islands, got {n_islands}")
         if migrate_every < 1 or migrate_k < 1:
             raise ValueError("migrate_every and migrate_k must be >= 1")
-        if num_objectives != 1:
-            raise ValueError(
-                "island migration selects elites by scalar fitness; "
-                "multi-objective islands are not supported"
-            )
+        if num_objectives < 1:
+            raise ValueError(f"num_objectives must be >= 1, got {num_objectives}")
         if fit_transforms:
             # migration writes raw (sign-flipped) fitness into algorithm
             # state; shaped fitness is population-relative and the stored
@@ -122,6 +125,7 @@ class IslandWorkflow:
         self.algorithm = algorithm
         self.problem = problem
         self.n_islands = n_islands
+        self.num_objectives = num_objectives
         self.migrate_every = migrate_every
         self.migrate_k = migrate_k
         self.monitors = tuple(monitors)
@@ -166,14 +170,27 @@ class IslandWorkflow:
         return fused_run(self, state, n_steps)
 
     def best(self, state: IslandWorkflowState) -> Tuple[jax.Array, jax.Array]:
-        """(island-stacked best fitness, global best) in the internal
-        minimization convention, from states carrying pbest/fitness."""
+        """(island-stacked best fitness, global best) in the USER
+        convention (same as the monitors report: a maximization run's
+        best comes back positive), from states carrying pbest/fitness.
+
+        Multi-objective: per-objective minima — the per-island ideal
+        points ``(islands, m)`` and the global ideal point ``(m,)``; for
+        the actual front use an :class:`~evox_tpu.monitors.EvalMonitor`
+        Pareto archive or ``state.algo.fitness`` directly."""
         astate = state.algo
         for name in ("gbest_fitness", "pbest_fitness", "fitness"):
             arr = getattr(astate, name, None)
             if arr is not None:
+                if self.num_objectives > 1:
+                    per_island = arr.reshape(
+                        self.n_islands, -1, self.num_objectives
+                    ).min(axis=1)
+                    sign = self.opt_direction
+                    return per_island * sign, per_island.min(axis=0) * sign
                 per_island = arr.reshape(self.n_islands, -1).min(axis=1)
-                return per_island, per_island.min()
+                sign = self.opt_direction[0]
+                return per_island * sign, per_island.min() * sign
         raise NotImplementedError(
             f"{type(astate).__name__} exposes no fitness field"
         )
@@ -196,16 +213,41 @@ class IslandWorkflow:
     def _evaluate(self, pstate: Any, cand_flat: Any) -> Tuple[jax.Array, Any]:
         if not self.external:
             return self.problem.evaluate(pstate, cand_flat)
-        return callback_evaluate(self.problem, pstate, cand_flat)
+        return callback_evaluate(
+            self.problem, pstate, cand_flat, self.num_objectives
+        )
 
     def _migrate(self, astate: Any, cand: Any, fitness: jax.Array) -> Any:
-        """Ring migration of each island's current top-k candidates."""
+        """Ring migration of each island's current top-k candidates.
+
+        Elites: scalar-fitness ``argsort`` for single-objective; for
+        multi-objective, non-dominated rank with crowding-distance
+        tie-break per island (the NSGA-II elite criterion)."""
         k = self.migrate_k
         if k > fitness.shape[1]:
             raise ValueError(
                 f"migrate_k={k} exceeds the per-island candidate batch "
                 f"({fitness.shape[1]})"
             )
+        if self.num_objectives > 1:
+            from ..operators.selection.non_dominate import (
+                crowding_distance,
+                non_dominated_sort,
+            )
+
+            def island_elites(fit):  # (B, m) -> (k,) indices
+                rank = non_dominated_sort(fit)
+                crowd = crowding_distance(fit)
+                return jnp.lexsort((-crowd, rank))[:k]
+
+            idx = jax.vmap(island_elites)(fitness)  # (islands, k)
+            elites = jax.tree.map(
+                lambda c: jax.vmap(lambda row, i: row[i])(c, idx), cand
+            )
+            elite_fit = jax.vmap(lambda f, i: f[i])(fitness, idx)
+            recv = jax.tree.map(lambda e: jnp.roll(e, 1, axis=0), elites)
+            recv_fit = jnp.roll(elite_fit, 1, axis=0)
+            return jax.vmap(self.algorithm.migrate)(astate, recv, recv_fit)
         idx = jnp.argsort(fitness, axis=1)[:, :k]  # best-k per island
         elites = jax.tree.map(
             lambda c: jax.vmap(lambda row, i: row[i])(c, idx), cand
@@ -247,12 +289,18 @@ class IslandWorkflow:
         # internal minimization convention, shared by tell and migration
         # (the constructor rejects fit_transforms: shaped fitness is
         # population-relative and would poison the migrants' stored values)
-        fitness = (raw_fitness * self.opt_direction[0]).reshape(
-            self.n_islands, batch
-        )
+        if self.num_objectives > 1:
+            fitness = (raw_fitness * self.opt_direction).reshape(
+                self.n_islands, batch, self.num_objectives
+            )
+        else:
+            fitness = (raw_fitness * self.opt_direction[0]).reshape(
+                self.n_islands, batch
+            )
 
         run_hooks(
-            self.monitors, self._hook_table, "pre_tell", mstates, fitness.reshape(-1)
+            self.monitors, self._hook_table, "pre_tell", mstates,
+            fitness.reshape((self.n_islands * batch,) + fitness.shape[2:]),
         )
         tell = self.algorithm.init_tell if use_init else self.algorithm.tell
         astate = jax.vmap(tell)(astate, fitness)

@@ -159,6 +159,9 @@ class StdWorkflow:
         self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
         # dynamic trip count: ONE compile covers every n_steps
         self._run_loop = make_run_loop(self._step_impl)
+        # jitted step halves for the host-overlap driver (pipelined.py)
+        self._p_ask = jax.jit(self._pipeline_ask_impl) if jit_step else self._pipeline_ask_impl
+        self._p_tell = jax.jit(self._pipeline_tell_impl) if jit_step else self._pipeline_tell_impl
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> StdWorkflowState:
@@ -213,7 +216,11 @@ class StdWorkflow:
         return self._ask_preview(state)
 
     def validate(
-        self, state: StdWorkflowState, problem: Optional[Problem] = None
+        self,
+        state: StdWorkflowState,
+        problem: Optional[Problem] = None,
+        key: Optional[jax.Array] = None,
+        problem_state: Any = None,
     ) -> jax.Array:
         """Score the current population on ``problem`` without ``tell``.
 
@@ -223,7 +230,10 @@ class StdWorkflow:
         ``problem`` defaults to the training problem; pass a
         validation-mode problem (e.g. ``DatasetProblem.valid()``) to score
         on held-out data. Eager utility: the validation problem's state is
-        created ad hoc.
+        created ad hoc — seed it with ``key`` (for keyed/stochastic
+        validation problems: rollout seeds, noisy benchmarks) or hand in a
+        pre-built ``problem_state`` to reuse running statistics
+        (e.g. observation-normalizer moments from training).
 
         Caveat: a training problem that consumes a host stream during
         ``evaluate`` (``DatasetProblem``, host env loops) still consumes
@@ -235,10 +245,20 @@ class StdWorkflow:
         for t in self.pop_transforms:
             cand = t(cand)
         cand = shard_pop(cand, self.mesh)
+        if problem_state is not None and problem is self.problem:
+            raise ValueError(
+                "problem_state is only meaningful with an explicit "
+                "validation problem"
+            )
         if problem is self.problem:
             fitness, _ = self._evaluate(state.prob, cand)
         else:
-            fitness, _ = problem.evaluate(problem.init(), cand)
+            pstate = (
+                problem_state
+                if problem_state is not None
+                else (problem.init(key) if key is not None else problem.init())
+            )
+            fitness, _ = problem.evaluate(pstate, cand)
         return fitness
 
     def _run_hooks(self, name: str, mstates: list, *args: Any) -> None:
@@ -291,6 +311,75 @@ class StdWorkflow:
             out_specs=(P(), P()),
             check_vma=False,
         )(pstate, cand)
+
+    # ----------------------------------------------- pipelined step halves
+    # _step_impl split at the evaluation boundary, for run_host_pipelined
+    # (workflows/pipelined.py): the host problem's evaluate runs eagerly in
+    # a worker thread between the two jitted halves. Hook order, transforms
+    # and state threading are identical to _step_impl, so a pipelined run
+    # produces bit-identical states to a wf.step loop.
+
+    def pipeline_ask(self, state: StdWorkflowState):
+        """(candidates, ctx): everything before evaluation, jitted."""
+        return self._p_ask(state)
+
+    def pipeline_tell(
+        self, state: StdWorkflowState, ctx, fitness: jax.Array, pstate: Any
+    ) -> StdWorkflowState:
+        """Everything after evaluation, jitted; consumes pipeline_ask's ctx
+        plus the host-computed (fitness, problem state)."""
+        return self._p_tell(state, ctx, fitness, pstate)
+
+    def _pipeline_ask_impl(self, state: StdWorkflowState):
+        mstates = list(state.monitors)
+        self._run_hooks("pre_step", mstates)
+        self._run_hooks("pre_ask", mstates)
+        _, pop, astate = self._dispatch_ask(state)
+        self._run_hooks("post_ask", mstates, pop)
+        cand = pop
+        for t in self.pop_transforms:
+            cand = t(cand)
+        cand = shard_pop(cand, self.mesh)
+        self._run_hooks("pre_eval", mstates, cand)
+        return cand, (astate, tuple(mstates), cand)
+
+    def _pipeline_tell_impl(
+        self, state: StdWorkflowState, ctx, fitness: jax.Array, pstate: Any
+    ) -> StdWorkflowState:
+        astate, mstates_t, cand = ctx
+        mstates = list(mstates_t)
+        fitness = shard_pop(fitness, self.mesh)
+        self._run_hooks("post_eval", mstates, cand, fitness)
+        fitness = self._flip(fitness)
+        for t in self.fit_transforms:
+            fitness = t(fitness)
+        self._run_hooks("pre_tell", mstates, fitness)
+        use_init = state.first_step and (
+            self.algorithm.has_init_ask or self.algorithm.has_init_tell
+        )
+        if use_init:
+            astate = self.algorithm.init_tell(astate, fitness)
+        else:
+            astate = self.algorithm.tell(astate, fitness)
+        if self.migrate_helper is not None:
+            do_migrate, foreign_pop, foreign_fit = self.migrate_helper()
+            foreign_fit = self._flip(foreign_fit)
+            astate = jax.lax.cond(
+                do_migrate,
+                lambda a: self.algorithm.migrate(a, foreign_pop, foreign_fit),
+                lambda a: a,
+                astate,
+            )
+        astate = constrain_state(astate, self.mesh)
+        self._run_hooks("post_tell", mstates)
+        new_state = state.replace(
+            generation=state.generation + 1,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(mstates),
+            first_step=False,
+        )
+        return finish_step(self.monitors, self._hook_table, new_state)
 
     def _step_impl(self, state: StdWorkflowState) -> StdWorkflowState:
         mstates = list(state.monitors)

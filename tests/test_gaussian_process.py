@@ -1,0 +1,88 @@
+"""Gaussian-process operators: exact regression and Laplace-Bernoulli
+classification (reference operators/gaussian_process/*: gpjax-backed;
+here pure JAX). The classification test follows the round-2 verdict:
+calibrated probabilities on a separable 2-D set, compared against the
+label-regression baseline — not just label accuracy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu.operators.gaussian_process import (
+    GPClassification,
+    GPRegression,
+    ProbitLabelRegression,
+)
+
+
+def test_gp_regression_interpolates():
+    x = jnp.linspace(0.0, 2.0 * jnp.pi, 24)
+    y = jnp.sin(x)
+    gp = GPRegression(fit_steps=80)
+    model = jax.jit(gp.fit)(x, y)
+    xt = jnp.linspace(0.3, 5.9, 17)
+    mean, var = gp.predict(model, xt)
+    np.testing.assert_allclose(np.asarray(mean), np.sin(xt), atol=0.1)
+    assert float(jnp.max(var)) < 0.5
+
+
+def _two_moons_ish(key, n=60):
+    """Separable 2-D set: two Gaussian blobs with a margin."""
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (n // 2, 2)) * 0.35 + jnp.array([-1.0, 0.0])
+    b = jax.random.normal(k2, (n // 2, 2)) * 0.35 + jnp.array([1.0, 0.0])
+    x = jnp.concatenate([a, b])
+    y = jnp.concatenate([jnp.zeros(n // 2), jnp.ones(n // 2)])
+    return x, y
+
+
+def test_laplace_classification_separable():
+    x, y = _two_moons_ish(jax.random.PRNGKey(0))
+    clf = GPClassification(lengthscale=0.8)
+    model = jax.jit(clf.fit)(x, y)
+    proba = clf.predict_proba(model, x)
+    labels = clf.predict_label(model, x)
+    acc = float(jnp.mean((labels == y.astype(jnp.int32)).astype(jnp.float32)))
+    assert acc >= 0.95, acc
+    # probabilities are probabilities
+    assert float(proba.min()) >= 0.0 and float(proba.max()) <= 1.0
+    # confident near the blob centers, uncertain on the decision boundary
+    centers = jnp.array([[-1.0, 0.0], [1.0, 0.0], [0.0, 0.0]])
+    p = np.asarray(clf.predict_proba(model, centers))
+    assert p[0] < 0.15 and p[1] > 0.85
+    assert 0.2 < p[2] < 0.8
+
+
+def test_laplace_calibration_beats_label_regression():
+    """Bernoulli-likelihood probabilities carry lower negative
+    log-likelihood on held-out points than the probit label-regression
+    shortcut (the round-2 implementation, kept as baseline)."""
+    x, y = _two_moons_ish(jax.random.PRNGKey(1), n=80)
+    xt, yt = _two_moons_ish(jax.random.PRNGKey(2), n=60)
+
+    clf = GPClassification(lengthscale=0.8)
+    base = ProbitLabelRegression(lengthscale=0.8, fit_steps=0)
+
+    def nll(p):
+        p = jnp.clip(p, 1e-6, 1 - 1e-6)
+        return float(-jnp.mean(yt * jnp.log(p) + (1 - yt) * jnp.log(1 - p)))
+
+    nll_laplace = nll(clf.predict_proba(jax.jit(clf.fit)(x, y), xt))
+    nll_base = nll(base.predict_proba(base.fit(x, y), xt))
+    assert nll_laplace < nll_base, (nll_laplace, nll_base)
+
+
+def test_laplace_hyperparameter_fitting_improves_evidence():
+    from evox_tpu.operators.gaussian_process.classification import (
+        _laplace_neg_evidence,
+    )
+
+    x, y = _two_moons_ish(jax.random.PRNGKey(3))
+    ypm = jnp.where(y > 0, 1.0, -1.0)
+    clf0 = GPClassification(lengthscale=3.0, fit_steps=0)
+    clf1 = GPClassification(lengthscale=3.0, fit_steps=40)
+    m0 = clf0.fit(x, y)
+    m1 = jax.jit(clf1.fit)(x, y)
+    e0 = float(_laplace_neg_evidence(m0.params, m0.x, ypm, 15))
+    e1 = float(_laplace_neg_evidence(m1.params, m1.x, ypm, 15))
+    assert e1 < e0, (e1, e0)

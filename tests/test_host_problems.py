@@ -278,6 +278,30 @@ def test_rollout_farm_modes(batch_policy):
     assert fit2.shape == (pop_size,)
 
 
+def test_rollout_farm_visualize_frames():
+    """Frame-level visualize (ref gym.py:383-426): collects env.render()
+    frames + per-step rewards for one policy; falls back to observations
+    for render-less envs."""
+
+    class _RenderCartPole(_ScalarCartPole):
+        def render(self):
+            return np.zeros((32, 32, 3), dtype=np.uint8)
+
+    apply, adapter = _policy_setup(1)
+    farm = HostRolloutFarm(apply, _RenderCartPole, num_workers=2)
+    params = adapter.to_tree(jnp.zeros(adapter.dim))
+    frames, rewards = farm.visualize(params, seed=3, max_steps=20)
+    assert 1 <= len(frames) <= 20
+    assert frames[0].shape == (32, 32, 3)
+    assert len(rewards) == len(frames)
+    assert rewards.min() >= 0.0
+
+    # env without render(): observation fallback via render=False
+    farm2 = HostRolloutFarm(apply, _ScalarCartPole, num_workers=2)
+    frames2, _ = farm2.visualize(params, seed=3, max_steps=10, render=False)
+    assert frames2[0].shape == (4,)  # cartpole observations
+
+
 def test_rollout_farm_mo_keys():
     pop_size = 16
     apply, adapter = _policy_setup(pop_size)

@@ -77,8 +77,8 @@ def test_islands_validate_constructor():
         IslandWorkflow(algo, Sphere(), n_islands=1)
     with pytest.raises(ValueError, match="divisible"):
         IslandWorkflow(algo, Sphere(), n_islands=6, mesh=create_mesh())
-    with pytest.raises(ValueError, match="multi-objective"):
-        IslandWorkflow(algo, Sphere(), n_islands=4, num_objectives=2)
+    with pytest.raises(ValueError, match="num_objectives"):
+        IslandWorkflow(algo, Sphere(), n_islands=4, num_objectives=0)
     with pytest.raises(ValueError, match="fit_transforms"):
         IslandWorkflow(
             algo, Sphere(), n_islands=4, fit_transforms=(lambda f: f,)
@@ -95,6 +95,104 @@ def test_default_migrate_replaces_worst():
     assert float(new.fitness.max()) == 5.0
     assert float(new.fitness.min()) == -2.0
     np.testing.assert_array_equal(np.asarray(new.population[7]), [0.5, 0.5])
+
+
+def test_default_migrate_rejects_worse_migrants():
+    """Elitist acceptance: a migrant worse than the row it would displace
+    is dropped (an unconditional overwrite would break e.g. the pbest
+    monotonicity invariant in PSO states)."""
+    algo = DE(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8)
+    state = algo.init(jax.random.PRNGKey(0))
+    state = state.replace(fitness=jnp.arange(8.0))
+    old_row7 = np.asarray(state.population[7])
+    migrants = jnp.full((2, 2), 0.5)
+    # migrant 0 (fit 100) is worse than the worst row (7) -> rejected;
+    # migrant 1 (fit -2) beats row 6 -> accepted
+    new = algo.migrate(state, migrants, jnp.array([100.0, -2.0]))
+    assert float(new.fitness.max()) == 7.0  # row 7 kept, not 100
+    np.testing.assert_array_equal(np.asarray(new.population[7]), old_row7)
+    assert float(new.fitness.min()) == -2.0
+    np.testing.assert_array_equal(np.asarray(new.population[6]), [0.5, 0.5])
+
+
+def test_islands_best_uses_user_convention():
+    """best() reports in the user's convention, matching the monitors: a
+    maximization run's best value comes back positive."""
+
+    class NegSphere(Sphere):
+        def evaluate(self, state, pop):
+            fit, state = super().evaluate(state, pop)
+            return -fit, state
+
+    algo = PSO(lb=jnp.full((3,), -5.0), ub=jnp.full((3,), 5.0), pop_size=16)
+    wf = IslandWorkflow(
+        algo, NegSphere(), n_islands=2, migrate_every=5, opt_direction="max"
+    )
+    state = wf.init(jax.random.PRNGKey(9))
+    state = wf.run(state, 20)
+    per_island, best = wf.best(state)
+    assert float(best) <= 0.0 + 1e-6  # max of -||x||^2 is 0, reported as ~-eps
+    assert np.all(np.asarray(per_island) <= 1e-6)
+    assert float(best) > -1.0  # converged toward 0 from below
+
+
+def test_mo_migrate_elitist_selection():
+    """GAMOAlgorithm.migrate: a dominating migrant joins the population,
+    a dominated one is filtered by the environmental selection, and the
+    cached (rank, crowd) mating keys are refreshed."""
+    from evox_tpu.algorithms.mo import NSGA2
+
+    algo = NSGA2(jnp.zeros(3), jnp.ones(3), n_objs=2, pop_size=8)
+    state = algo.init(jax.random.PRNGKey(0))
+    # a simple front: fitness on the line x + y = 1
+    f = jnp.stack([jnp.linspace(0, 1, 8), 1 - jnp.linspace(0, 1, 8)], axis=1)
+    state = algo.init_tell(state, f)
+    migrants = jnp.full((2, 3), 0.5)
+    mig_fit = jnp.array([[0.1, 0.1], [2.0, 2.0]])  # dominates all / dominated
+    new = algo.migrate(state, migrants, mig_fit)
+    assert new.population.shape == (8, 3)
+    fits = np.asarray(new.fitness)
+    assert any(np.allclose(r, [0.1, 0.1]) for r in fits)  # good migrant in
+    assert not any(np.allclose(r, [2.0, 2.0]) for r in fits)  # bad one out
+    # mating keys refreshed: the dominating migrant is rank 0
+    mig_row = int(np.argmin(fits.sum(axis=1)))
+    assert int(np.asarray(new.rank)[mig_row]) == 0
+
+
+def test_mo_islands_nsga2_zdt1():
+    """Islands + NSGA-II on ZDT1: migration improves IGD over isolated
+    islands at equal total evaluations, and the combined front converges."""
+    from evox_tpu.algorithms.mo import NSGA2
+    from evox_tpu.metrics import igd
+    from evox_tpu.problems.numerical import ZDT1
+
+    zdt_dim = 12
+    prob = ZDT1(n_dim=zdt_dim)
+
+    def run(migrate_every):
+        algo = NSGA2(
+            jnp.zeros(zdt_dim), jnp.ones(zdt_dim), n_objs=2, pop_size=32
+        )
+        wf = IslandWorkflow(
+            algo,
+            prob,
+            n_islands=4,
+            migrate_every=migrate_every,
+            migrate_k=4,
+            num_objectives=2,
+        )
+        state = wf.init(jax.random.PRNGKey(11))
+        state = wf.run(state, 100)
+        per_island, ideal = wf.best(state)
+        assert per_island.shape == (4, 2) and ideal.shape == (2,)
+        fit = np.asarray(state.algo.fitness).reshape(-1, 2)
+        fit = np.where(np.isfinite(fit), fit, 1e6)
+        return float(igd(jnp.asarray(fit), prob.pf()))
+
+    igd_mig = run(5)
+    igd_iso = run(10**6)  # never migrates within the run
+    assert igd_mig < igd_iso, (igd_mig, igd_iso)
+    assert igd_mig < 0.15, igd_mig  # measured 0.11 vs isolated 0.23
 
 
 def test_migrate_unsupported_state_raises():
@@ -151,6 +249,6 @@ def test_islands_neuroevolution_composability():
     )
     state = wf.init(jax.random.PRNGKey(5))
     state = wf.run(state, 25)
-    # internal convention: maximization flips sign, so best is negative
+    # best() reports in the user convention: reward, bigger is better
     _, best = wf.best(state)
-    assert float(-best) > 50.0, float(-best)
+    assert float(best) > 50.0, float(best)

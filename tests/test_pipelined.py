@@ -1,0 +1,95 @@
+"""run_host_pipelined: the host-overlap driver (reference
+workflows/distributed.py:361-369 async-dispatch analog).
+
+Two contracts: (1) results are bit-identical to a serial wf.step loop —
+the pipeline only reorders wall-clock, never data flow; (2) host
+evaluation genuinely overlaps the per-generation host callback, shown by
+wall-clock on sleep-instrumented problem + hook."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu import StdWorkflow, run_host_pipelined
+from evox_tpu.algorithms.so.pso import PSO
+from evox_tpu.core.problem import Problem
+
+
+class _HostSphere(Problem):
+    """Deterministic host-side problem (non-jittable), optional sleep."""
+
+    jittable = False
+
+    def __init__(self, sleep: float = 0.0):
+        self.sleep = sleep
+        self.calls = 0
+
+    def init(self, key=None):
+        return jnp.zeros(())
+
+    def evaluate(self, state, pop):
+        self.calls += 1
+        if self.sleep:
+            time.sleep(self.sleep)
+        return jnp.sum(jnp.asarray(pop) ** 2, axis=1), state
+
+
+def _build(sleep=0.0):
+    algo = PSO(lb=-5.0 * jnp.ones(3), ub=5.0 * jnp.ones(3), pop_size=16)
+    prob = _HostSphere(sleep)
+    return StdWorkflow(algo, prob), prob
+
+
+def test_pipelined_matches_serial_step_loop():
+    wf_a, _ = _build()
+    wf_b, _ = _build()
+    s_serial = wf_a.init(jax.random.PRNGKey(3))
+    s_pipe = wf_b.init(jax.random.PRNGKey(3))
+    for _ in range(6):
+        s_serial = wf_a.step(s_serial)
+    s_pipe = run_host_pipelined(wf_b, s_pipe, 6)
+    assert int(s_pipe.generation) == 6
+    np.testing.assert_array_equal(
+        np.asarray(s_serial.algo.population), np.asarray(s_pipe.algo.population)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s_serial.algo.pbest_fitness),
+        np.asarray(s_pipe.algo.pbest_fitness),
+    )
+
+
+def test_pipelined_overlaps_host_work():
+    """eval (80 ms) and on_generation (60 ms) overlap: the pipelined loop
+    must beat the serial sum by a clear margin."""
+    n, t_eval, t_hook = 6, 0.08, 0.06
+    wf, prob = _build(sleep=t_eval)
+    state = wf.init(jax.random.PRNGKey(0))
+    # warm both jitted halves (first_step=True and False variants) so the
+    # timed region measures overlap, not compilation
+    state = run_host_pipelined(wf, state, 3)
+    warm_calls = prob.calls
+
+    def hook(g, st, fit):
+        time.sleep(t_hook)
+
+    t0 = time.perf_counter()
+    state = run_host_pipelined(wf, state, n, on_generation=hook)
+    jax.block_until_ready(state.algo.population)
+    pipelined = time.perf_counter() - t0
+
+    serial_floor = n * (t_eval + t_hook)  # what a serial loop must spend
+    assert pipelined < serial_floor * 0.85, (pipelined, serial_floor)
+    assert prob.calls == warm_calls + n
+
+
+def test_pipelined_rejects_jittable_problem():
+    from evox_tpu.problems.numerical import Sphere
+    import pytest
+
+    algo = PSO(lb=-jnp.ones(2), ub=jnp.ones(2), pop_size=8)
+    wf = StdWorkflow(algo, Sphere())
+    state = wf.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="external"):
+        run_host_pipelined(wf, state, 2)
