@@ -1,4 +1,4 @@
-from . import plot
+from . import plot, plotly_json
 from .plot import (
     plot_dec_space,
     plot_obj_space_1d,
@@ -8,6 +8,7 @@ from .plot import (
 
 __all__ = [
     "plot",
+    "plotly_json",
     "plot_dec_space",
     "plot_obj_space_1d",
     "plot_obj_space_2d",

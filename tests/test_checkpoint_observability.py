@@ -223,6 +223,62 @@ def test_vis_1d_animated():
     assert hasattr(anim, "save")
 
 
+def test_plotly_json_figures(tmp_path):
+    """plotly_json emits plotly-schema figure dicts (the reference's
+    plotly animation capability, reference vis_tools/plot.py, without the
+    plotly dependency): frames + generation slider + play/pause controls,
+    JSON-serializable, and a standalone HTML export."""
+    import json
+
+    import numpy as np
+
+    from evox_tpu.vis_tools import plotly_json as pj
+
+    rng = np.random.default_rng(0)
+    pops = [rng.normal(size=(16, 2)) for _ in range(5)]
+    fits1 = [rng.normal(size=(16,)) + 10 - g for g in range(5)]
+    fits2 = [rng.uniform(size=(16, 2)) for _ in range(5)]
+    fits3 = [rng.uniform(size=(16, 3)) for _ in range(5)]
+
+    fig = pj.plot_dec_space(pops)
+    assert set(fig) == {"data", "layout", "frames"}
+    assert len(fig["frames"]) == 5
+    assert len(fig["layout"]["sliders"][0]["steps"]) == 5
+    assert fig["layout"]["updatemenus"][0]["buttons"][0]["label"] == "Play"
+    assert fig["frames"][2]["data"][0]["type"] == "scatter"
+    json.dumps(fig)  # strictly JSON-serializable
+
+    f1 = pj.plot_obj_space_1d(fits1)
+    # frame i reveals i+1 generations of the Min curve
+    assert len(f1["frames"][2]["data"][0]["x"]) == 3
+    assert f1["frames"][4]["data"][0]["name"] == "Min"
+    static = pj.plot_obj_space_1d(fits1, animation=False)
+    assert "frames" not in static and len(static["data"]) == 4
+    # min curve is what it says
+    assert static["data"][0]["y"][0] == float(np.min(fits1[0]))
+
+    pf = np.stack([np.linspace(0, 1, 8), 1 - np.linspace(0, 1, 8)], axis=1)
+    f2 = pj.plot_obj_space_2d(fits2, problem_pf=pf, sort_points=True)
+    assert f2["frames"][0]["data"][0]["name"] == "Pareto Front"
+    f3 = pj.plot_obj_space_3d(fits3)
+    assert f3["frames"][0]["data"][0]["type"] == "scatter3d"
+    assert "scene" in f3["layout"]
+
+    out = tmp_path / "fig.html"
+    pj.save_html(fig, str(out))
+    text = out.read_text()
+    assert "Plotly.newPlot" in text and "addFrames" in text
+    assert json.loads(pj.to_json(fig)) == fig
+
+    # script-injection guard: '</script>' in user strings must not
+    # terminate the embedding <script> element or escape the title
+    evil = pj.plot_dec_space(pops, title={"text": "a</script><b>"})
+    out2 = tmp_path / "evil.html"
+    pj.save_html(evil, str(out2), title="<t>")
+    body = out2.read_text()
+    assert "a</script>" not in body and "<title>&lt;t&gt;</title>" in body
+
+
 def test_checkpoint_monitor_autosaves(tmp_path):
     from evox_tpu.monitors import CheckpointMonitor
 

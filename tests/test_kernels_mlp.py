@@ -146,6 +146,40 @@ def test_fused_planes_engine_matches_scan_engine():
     )
 
 
+def test_fused_planes_multichip_shard_map():
+    """The big-policy engine also runs per-shard under the shard_map
+    evaluation path on a mesh, matching single-device."""
+    from evox_tpu import StdWorkflow
+    from evox_tpu.algorithms.so.es import OpenES
+    from evox_tpu.core.distributed import create_mesh
+    from evox_tpu.utils import TreeAndVector
+
+    penv = chain_walker_planes(max_steps=10)
+    init_params, apply = mlp_policy((244, 16, 8, 17))
+    adapter = TreeAndVector(init_params(jax.random.PRNGKey(0)))
+
+    def build(mesh=None, island=False):
+        prob = PolicyRolloutProblem(
+            apply, penv.base, num_episodes=1, stochastic_reset=False,
+            fused_planes=penv, fused_interpret=True,
+        )
+        algo = OpenES(jnp.zeros(adapter.dim), 16, learning_rate=0.05)
+        return StdWorkflow(
+            algo, prob, opt_direction="max",
+            pop_transforms=(adapter.batched_to_tree,),
+            mesh=mesh, eval_shard_map=island,
+        )
+
+    mesh = create_mesh()
+    centers = []
+    for mesh_arg, island in ((mesh, True), (None, False)):
+        wf = build(mesh_arg, island)
+        st = wf.init(jax.random.PRNGKey(1))
+        st = wf.step(st)
+        centers.append(np.asarray(st.algo.center))
+    np.testing.assert_allclose(centers[0], centers[1], rtol=1e-4, atol=1e-4)
+
+
 def test_fused_planes_rejects_wrong_policy():
     penv = chain_walker_planes(max_steps=10)
     init_params, apply = mlp_policy((244, 16, 8, 17), activation=jax.nn.relu)
