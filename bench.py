@@ -192,8 +192,9 @@ def bench_rollout_ref():
 # physics, termination on falling — the Brax-Humanoid workload shape from
 # BASELINE.md, reference brax.py:45-97). 2-hidden-layer MLP (244-64-64-17,
 # dim=20945); pop=16384 keeps BOTH frameworks' (pop, dim) states co-resident
-# during interleaved measurement inside one chip's 16 GB HBM (32768 fits one
-# side alone; 65536 OOMs outright at dim 20945). The workload is HBM-bound
+# during interleaved measurement inside one chip's 16 GB HBM (our side alone
+# now runs the full BASELINE pop=65536 at 341k evals/sec — PERF_NOTES §10 —
+# but the reference side must coexist here). The workload is HBM-bound
 # on per-step policy-weight re-reads; ours runs the big-policy fused kernel
 # (kernels/rollout_mlp.py: a tile of individuals' full weight matrices
 # resident in VMEM across the episode — measured ~6x the scan engine,

@@ -15,17 +15,21 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
-from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
 from .common import make_optimizer
 
 
 class OpenESState(PyTreeNode):
-    # center/optimizer replicate; the (pop, dim) noise batch — the big
-    # array at north-star populations — shards over the pop axis
+    # center/optimizer replicate. The (pop, dim) noise batch is NOT
+    # stored: tell regenerates it from noise_key (counter-based PRNG is
+    # deterministic, so ask and tell see bit-identical noise) — at
+    # north-star scale the stored batch would be the dominant state
+    # buffer (pop=65536 x dim=20945 = 5.5 GB), and dropping it is what
+    # lets the humanoid-scale workload run at the BASELINE.md population
+    # on one chip.
     center: jax.Array = field(sharding=P())
     opt_state: tuple = field(sharding=P())
-    noise: jax.Array = field(sharding=P(POP_AXIS))
+    noise_key: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
 
@@ -51,26 +55,48 @@ class OpenES(Algorithm):
         self.optimizer = make_optimizer(optimizer, learning_rate)
 
     def init(self, key: jax.Array) -> OpenESState:
+        key, k = jax.random.split(key)
         return OpenESState(
             center=self.center_init,
             opt_state=self.optimizer.init(self.center_init),
-            noise=jnp.zeros((self.pop_size, self.dim)),
+            noise_key=k,
             key=key,
         )
 
-    def ask(self, state: OpenESState) -> Tuple[jax.Array, OpenESState]:
-        key, k = jax.random.split(state.key)
+    def _noise(self, k: jax.Array) -> jax.Array:
         if self.mirrored:
             half = jax.random.normal(k, (self.pop_size // 2, self.dim))
-            noise = jnp.concatenate([half, -half], axis=0)
-        else:
-            noise = jax.random.normal(k, (self.pop_size, self.dim))
-        pop = state.center + self.noise_stdev * noise
-        return pop, state.replace(noise=noise, key=key)
+            return jnp.concatenate([half, -half], axis=0)
+        return jax.random.normal(k, (self.pop_size, self.dim))
+
+    def ask(self, state: OpenESState) -> Tuple[jax.Array, OpenESState]:
+        key, k = jax.random.split(state.key)
+        # the regenerated batch is a jit transient: under a mesh its
+        # sharding comes from GSPMD propagating backward from the
+        # workflow's shard_pop constraint on the emitted population (and
+        # from the sharded fitness in tell's contraction) rather than
+        # from a state-field annotation as before
+        pop = state.center + self.noise_stdev * self._noise(k)
+        return pop, state.replace(noise_key=k, key=key)
 
     def tell(self, state: OpenESState, fitness: jax.Array) -> OpenESState:
-        # minimize: estimated gradient of E[f] wrt center
-        grad = state.noise.T @ fitness / (self.pop_size * self.noise_stdev)
+        # minimize: estimated gradient of E[f] wrt center; noise is
+        # regenerated from the paired ask's key (bit-identical values, no
+        # persistent (pop, dim) buffer — see OpenESState). Mirrored
+        # sampling folds: noise.T @ f == half.T @ (f_pos - f_neg), so the
+        # dominant transient is (pop/2, dim), not (pop, dim).
+        if self.mirrored:
+            half = jax.random.normal(
+                state.noise_key, (self.pop_size // 2, self.dim)
+            )
+            m = self.pop_size // 2
+            grad = half.T @ (fitness[:m] - fitness[m:])
+        else:
+            noise = jax.random.normal(
+                state.noise_key, (self.pop_size, self.dim)
+            )
+            grad = noise.T @ fitness
+        grad = grad / (self.pop_size * self.noise_stdev)
         updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
         return state.replace(
             center=optax.apply_updates(state.center, updates),

@@ -11,7 +11,8 @@ individuals' full weight matrices resident in VMEM for the whole episode
 Run (real TPU):
     PYTHONPATH=/root/repo:/root/.axon_site python examples/humanoid_walker.py
 or CPU (slow, interpret-mode kernel):
-    JAX_PLATFORMS=cpu python examples/humanoid_walker.py --pop 256 --gens 5
+    PYTHONPATH=/root/repo JAX_PLATFORMS=cpu \
+        python examples/humanoid_walker.py --pop 256 --gens 5
 """
 
 import argparse
