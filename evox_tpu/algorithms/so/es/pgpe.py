@@ -15,8 +15,10 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from jax.sharding import PartitionSpec as P
+
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from ....core.struct import PyTreeNode, field
 from ....utils.optimizers import clipup, make_optimizer
 
 # Alias matching the reference's ClipUp class name (pgpe.py:34-64)
@@ -24,11 +26,16 @@ ClipUp = clipup
 
 
 class PGPEState(PyTreeNode):
-    center: jax.Array
-    stdev: jax.Array
-    opt_state: tuple
-    delta: jax.Array
-    key: jax.Array
+    # the (pop/2, dim) delta batch is NOT stored: tell regenerates it from
+    # delta_key (counter-based PRNG) with the ask-time stdev, which is
+    # still in state because only tell updates it — bit-identical values,
+    # no persistent perturbation buffer (same memory argument as
+    # OpenESState: at north-star policy dims the buffer dominates HBM)
+    center: jax.Array = field(sharding=P())
+    stdev: jax.Array = field(sharding=P())
+    opt_state: tuple = field(sharding=P())
+    delta_key: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class PGPE(Algorithm):
@@ -52,30 +59,41 @@ class PGPE(Algorithm):
         self.optimizer = make_optimizer(optimizer, center_learning_rate)
 
     def init(self, key: jax.Array) -> PGPEState:
+        key, k = jax.random.split(key)
         return PGPEState(
             center=self.center_init,
             stdev=jnp.full((self.dim,), self.stdev_init, dtype=jnp.float32),
             opt_state=self.optimizer.init(self.center_init),
-            delta=jnp.zeros((self.pop_size // 2, self.dim)),
+            delta_key=k,
             key=key,
+        )
+
+    def _delta(self, state: PGPEState) -> jax.Array:
+        return (
+            jax.random.normal(state.delta_key, (self.pop_size // 2, self.dim))
+            * state.stdev
         )
 
     def ask(self, state: PGPEState) -> Tuple[jax.Array, PGPEState]:
         key, k = jax.random.split(state.key)
-        delta = jax.random.normal(k, (self.pop_size // 2, self.dim)) * state.stdev
+        state = state.replace(delta_key=k, key=key)
+        delta = self._delta(state)
         pop = jnp.concatenate([state.center + delta, state.center - delta], axis=0)
-        return pop, state.replace(delta=delta, key=key)
+        return pop, state
 
     def tell(self, state: PGPEState, fitness: jax.Array) -> PGPEState:
         half = self.pop_size // 2
         f_pos, f_neg = fitness[:half], fitness[half:]
+        # delta regenerated from the paired ask's key (state.stdev is
+        # still the ask-time stdev — only tell updates it)
+        delta = self._delta(state)
         # minimization: descend the fitness landscape
-        center_grad = ((f_pos - f_neg) / 2.0) @ state.delta / half
+        center_grad = ((f_pos - f_neg) / 2.0) @ delta / half
         updates, opt_state = self.optimizer.update(center_grad, state.opt_state, state.center)
         center = optax.apply_updates(state.center, updates)
 
         baseline = jnp.mean(fitness)
-        s = (state.delta**2 - state.stdev**2) / state.stdev
+        s = (delta**2 - state.stdev**2) / state.stdev
         stdev_grad = ((f_pos + f_neg) / 2.0 - baseline) @ s / half
         # bounded multiplicative update (reference pgpe.py:118-133 behavior)
         allowed = self.stdev_max_change * state.stdev
