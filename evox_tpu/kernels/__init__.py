@@ -10,14 +10,24 @@ fallback stays the default.
 """
 
 from .dominance import packed_dominance, packed_dominance_reference
-from .rollout import SoAEnv, fused_rollout, pendulum_soa
+from .rollout import (
+    SoAEnv,
+    acrobot_soa,
+    cartpole_soa,
+    fused_rollout,
+    mountain_car_soa,
+    pendulum_soa,
+)
 from .rollout_mlp import PlaneEnv, chain_walker_planes, fused_mlp_rollout
 
 __all__ = [
     "packed_dominance",
     "packed_dominance_reference",
     "SoAEnv",
+    "acrobot_soa",
+    "cartpole_soa",
     "fused_rollout",
+    "mountain_car_soa",
     "pendulum_soa",
     "PlaneEnv",
     "chain_walker_planes",

@@ -9,12 +9,14 @@ inside one Pallas program — policy weights, env state and activations
 stay resident in VMEM across all T steps; HBM sees one theta read and one
 fitness write per environment, total.
 
-Scope: the MLP policy from ``mlp_policy``-style flat genomes and envs
-expressed in SoA form over component arrays. ``pendulum_step_soa`` ships
-as the built-in instance (the bench workload); other never-terminating
-classic-control envs fit the same mold. The generic while_loop rollout
-remains the default engine — this kernel is the opt-in fast path for the
-fixed-horizon case (``PolicyRolloutProblem(early_exit=False)`` shapes).
+Scope: the MLP policy from ``flat_mlp_policy`` flat genomes and envs
+expressed in SoA form over component arrays. Built-ins: ``pendulum_soa``
+(the bench workload), ``cartpole_soa``, ``mountain_car_soa`` and
+``acrobot_soa`` — terminating envs run under a sticky in-kernel done
+mask with the standard engine's frozen-episode reward accounting, so
+fitness matches both ``early_exit`` modes of the generic engine (which
+remains the default; this kernel is the opt-in fast path, strongest on
+never-terminating or long-surviving episodes — PERF_NOTES §8).
 
 CPU interpret-mode tests (tests/test_kernels.py) pin the kernel to the
 scan rollout's numerics; measured v5e numbers live in docs/PERF_NOTES.md
@@ -50,12 +52,20 @@ class SoAEnv(NamedTuple):
     reset — so the fused path draws the *same* initial states as the scan
     path and the numerics-pinning tests can compare them directly);
     ``to_soa`` converts a batched AoS state ``(n, ...)`` into the dict of
-    ``(n,)`` component arrays that ``step_soa``/``obs_soa`` operate on."""
+    ``(n,)`` component arrays that ``step_soa``/``obs_soa`` operate on.
+    ``step_soa`` returns ``(state, reward, done)`` — terminating envs get
+    a sticky in-kernel done mask (rewards after termination are dropped,
+    exactly like the standard engine's frozen-episode accounting);
+    never-terminating envs return a constant-False plane that the
+    compiler eliminates."""
 
     base: Any  # EnvSpec
     to_soa: Callable[[Any], SoAState]
     obs_soa: Callable[[SoAState], Tuple[jax.Array, ...]]
-    step_soa: Callable[[SoAState, Tuple[jax.Array, ...]], Tuple[SoAState, jax.Array]]
+    step_soa: Callable[
+        [SoAState, Tuple[jax.Array, ...]],
+        Tuple[SoAState, jax.Array, jax.Array],
+    ]
 
 
 def pendulum_reset_soa(key: jax.Array, n: int) -> SoAState:
@@ -71,9 +81,7 @@ def pendulum_obs_soa(s: SoAState) -> Tuple[jax.Array, ...]:
     return (jnp.cos(s["th"]), jnp.sin(s["th"]), s["thdot"])
 
 
-def pendulum_step_soa(
-    s: SoAState, a: Tuple[jax.Array, ...]
-) -> Tuple[SoAState, jax.Array]:
+def pendulum_step_soa(s: SoAState, a: Tuple[jax.Array, ...]):
     """One step on (tile,) component arrays; identical math to
     control/envs.pendulum (envs.py:76-101)."""
     max_speed, max_torque, dt, g = 8.0, 2.0, 0.05, 10.0
@@ -83,7 +91,8 @@ def pendulum_step_soa(
     cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
     thdot = thdot + (3.0 * g / 2.0 * jnp.sin(th) + 3.0 * u) * dt
     thdot = jnp.clip(thdot, -max_speed, max_speed)
-    return {"th": th + thdot * dt, "thdot": thdot}, -cost
+    never_done = jnp.zeros_like(th, dtype=bool)
+    return {"th": th + thdot * dt, "thdot": thdot}, -cost, never_done
 
 
 def pendulum_soa(max_steps: int = 200) -> SoAEnv:
@@ -95,6 +104,150 @@ def pendulum_soa(max_steps: int = 200) -> SoAEnv:
         to_soa=lambda s: {"th": s[..., 0], "thdot": s[..., 1]},
         obs_soa=pendulum_obs_soa,
         step_soa=pendulum_step_soa,
+    )
+
+
+def cartpole_soa(max_steps: int = 500) -> SoAEnv:
+    """control/envs.cartpole over SoA planes (terminating: uses the
+    kernel's sticky done mask). Identical math to envs.py:35-71."""
+    from ..problems.neuroevolution.control.envs import cartpole
+
+    gravity, masscart, masspole = 9.8, 1.0, 0.1
+    total_mass = masscart + masspole
+    length = 0.5
+    polemass_length = masspole * length
+    force_mag = 10.0
+    tau = 0.02
+    theta_limit = 12 * 2 * jnp.pi / 360
+    x_limit = 2.4
+
+    def obs_soa(s):
+        return (s["x"], s["xd"], s["th"], s["thd"])
+
+    def step_soa(s, a):
+        # arithmetic select (2c-1 maps {0,1} -> {-1,+1}): scalar-branch
+        # jnp.where on the episode blocks trips a Mosaic replicated-layout
+        # bug ("invalid relayout: non-singleton logical dimension")
+        go_right = (a[1] > a[0]).astype(a[0].dtype)
+        force = force_mag * (2.0 * go_right - 1.0)
+        x, x_dot, th, th_dot = s["x"], s["xd"], s["th"], s["thd"]
+        costh, sinth = jnp.cos(th), jnp.sin(th)
+        temp = (force + polemass_length * th_dot**2 * sinth) / total_mass
+        thacc = (gravity * sinth - costh * temp) / (
+            length * (4.0 / 3.0 - masspole * costh**2 / total_mass)
+        )
+        xacc = temp - polemass_length * thacc * costh / total_mass
+        x = x + tau * x_dot
+        x_dot = x_dot + tau * xacc
+        th = th + tau * th_dot
+        th_dot = th_dot + tau * thacc
+        done = (jnp.abs(x) > x_limit) | (jnp.abs(th) > theta_limit)
+        new = {"x": x, "xd": x_dot, "th": th, "thd": th_dot}
+        # 1.0 written as a data-derived value: a pure constant splat here
+        # is the one reward form that trips the Mosaic relayout bug
+        reward = 1.0 + 0.0 * x
+        return new, reward, done
+
+    return SoAEnv(
+        base=cartpole(max_steps=max_steps),
+        to_soa=lambda s: {
+            "x": s[..., 0], "xd": s[..., 1], "th": s[..., 2], "thd": s[..., 3]
+        },
+        obs_soa=obs_soa,
+        step_soa=step_soa,
+    )
+
+
+def mountain_car_soa(max_steps: int = 999) -> SoAEnv:
+    """control/envs.mountain_car over SoA planes (envs.py:106-127)."""
+    from ..problems.neuroevolution.control.envs import mountain_car
+
+    power = 0.0015
+
+    def obs_soa(s):
+        return (s["pos"], s["vel"])
+
+    def step_soa(s, a):
+        pos, vel = s["pos"], s["vel"]
+        force = jnp.clip(a[0], -1.0, 1.0)
+        vel = vel + force * power - 0.0025 * jnp.cos(3.0 * pos)
+        vel = jnp.clip(vel, -0.07, 0.07)
+        pos = jnp.clip(pos + vel, -1.2, 0.6)
+        # arithmetic selects (see cartpole_soa: Mosaic replicated-layout)
+        at_wall = ((pos <= -1.2) & (vel < 0)).astype(vel.dtype)
+        vel = vel * (1.0 - at_wall)
+        done = pos >= 0.45
+        reward = 100.0 * done.astype(pos.dtype) - 0.1 * force**2
+        return {"pos": pos, "vel": vel}, reward, done
+
+    return SoAEnv(
+        base=mountain_car(max_steps=max_steps),
+        to_soa=lambda s: {"pos": s[..., 0], "vel": s[..., 1]},
+        obs_soa=obs_soa,
+        step_soa=step_soa,
+    )
+
+
+def acrobot_soa(max_steps: int = 500) -> SoAEnv:
+    """control/envs.acrobot over SoA planes (envs.py:132-179); the
+    3-logit argmax becomes nested elementwise selects (first-max wins,
+    like jnp.argmax)."""
+    from ..problems.neuroevolution.control.envs import acrobot
+
+    dt = 0.2
+    l1 = m1 = m2 = 1.0
+    lc1 = lc2 = 0.5
+    I1 = I2 = 1.0
+    g = 9.8
+
+    def obs_soa(s):
+        t1, t2 = s["t1"], s["t2"]
+        return (
+            jnp.cos(t1), jnp.sin(t1), jnp.cos(t2), jnp.sin(t2),
+            s["td1"], s["td2"],
+        )
+
+    def step_soa(s, a):
+        # arithmetic argmax->torque (see cartpole_soa: Mosaic
+        # replicated-layout); first-max wins like jnp.argmax
+        c0 = ((a[0] >= a[1]) & (a[0] >= a[2])).astype(a[0].dtype)
+        inner = (a[1] < a[2]).astype(a[0].dtype)  # 0 -> torque 0, 1 -> +1
+        torque = -c0 + (1.0 - c0) * inner
+        t1, t2, td1, td2 = s["t1"], s["t2"], s["td1"], s["td2"]
+        d1 = (
+            m1 * lc1**2
+            + m2 * (l1**2 + lc2**2 + 2 * l1 * lc2 * jnp.cos(t2))
+            + I1
+            + I2
+        )
+        d2 = m2 * (lc2**2 + l1 * lc2 * jnp.cos(t2)) + I2
+        phi2 = m2 * lc2 * g * jnp.cos(t1 + t2 - jnp.pi / 2.0)
+        phi1 = (
+            -m2 * l1 * lc2 * td2**2 * jnp.sin(t2)
+            - 2 * m2 * l1 * lc2 * td2 * td1 * jnp.sin(t2)
+            + (m1 * lc1 + m2 * l1) * g * jnp.cos(t1 - jnp.pi / 2.0)
+            + phi2
+        )
+        tdd2 = (
+            torque + d2 / d1 * phi1 - m2 * l1 * lc2 * td1**2 * jnp.sin(t2) - phi2
+        ) / (m2 * lc2**2 + I2 - d2**2 / d1)
+        tdd1 = -(d2 * tdd2 + phi1) / d1
+        td1 = jnp.clip(td1 + dt * tdd1, -4 * jnp.pi, 4 * jnp.pi)
+        td2 = jnp.clip(td2 + dt * tdd2, -9 * jnp.pi, 9 * jnp.pi)
+        t1 = t1 + dt * td1
+        t2 = t2 + dt * td2
+        done = -jnp.cos(t1) - jnp.cos(t2 + t1) > 1.0
+        reward = done.astype(t1.dtype) - 1.0  # 0 when done, else -1
+        return {"t1": t1, "t2": t2, "td1": td1, "td2": td2}, reward, done
+
+    return SoAEnv(
+        base=acrobot(max_steps=max_steps),
+        to_soa=lambda s: {
+            "t1": s[..., 0], "t2": s[..., 1],
+            "td1": s[..., 2], "td2": s[..., 3],
+        },
+        obs_soa=obs_soa,
+        step_soa=step_soa,
     )
 
 
@@ -144,18 +297,31 @@ def _rollout_kernel(
     obs_soa: Callable,
     state_keys: Tuple[str, ...],
 ):
-    state = {k: r[:] for k, r in zip(state_keys, state_refs)}
+    # drop the leading episode-block dim: every per-env value in the body
+    # is then a uniform 2-D (rows, 128) block, same rank as the theta
+    # slices — mixed-rank broadcasts here trip Mosaic relayout bugs on
+    # some step functions ("non-singleton logical dimension is
+    # replicated")
+    state = {k: r[0] for k, r in zip(state_keys, state_refs)}
     total0 = jnp.zeros_like(state[state_keys[0]])
+    done0 = jnp.zeros_like(total0)  # sticky float mask (0 = live)
 
     def body(_, carry):
-        state, total = carry
+        state, done, total = carry
         obs = obs_soa(state)
         a = _mlp_act(theta_ref, obs, obs_dim, hidden, act_dim)
-        state, reward = step_soa(state, a)
-        return state, total + reward
+        state, reward, step_done = step_soa(state, a)
+        # frozen-episode accounting, same as the standard engine: the
+        # terminating step's reward counts, later ones don't. Same-shape
+        # where operands: a scalar branch here trips a Mosaic relayout
+        # bug ("non-singleton logical dimension is replicated") on the
+        # (1, rows, 128) episode blocks.
+        total = total + jnp.where(done > 0.5, jnp.zeros_like(reward), reward)
+        done = jnp.maximum(done, step_done.astype(done.dtype))
+        return state, done, total
 
-    _, total = jax.lax.fori_loop(0, T, body, (state, total0))
-    out_ref[:] = total
+    _, _, total = jax.lax.fori_loop(0, T, body, (state, done0, total0))
+    out_ref[0] = total
 
 
 @functools.partial(

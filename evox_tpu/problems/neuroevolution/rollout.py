@@ -152,21 +152,28 @@ class PolicyRolloutProblem(Problem):
             ``lax.scan`` unrolled by ``unroll``, trading the per-iteration
             loop overhead for straight-line code XLA can pipeline — a real
             throughput win at large populations. Incompatible with
-            ``cap_episode`` (the cap is a traced bound).
+            ``cap_episode`` (the cap is a traced bound). Ignored by the
+            ``fused_env`` engine, which always runs the full fixed
+            horizon with a done mask (same fitness either way; no early
+            exit — see PERF_NOTES §8's caveat for fast-dying envs).
         unroll: scan unroll factor for the ``early_exit=False`` path.
         fused_env: an :class:`~evox_tpu.kernels.rollout.SoAEnv` — switches
             ``evaluate`` to the fused Pallas rollout kernel
             (:func:`~evox_tpu.kernels.rollout.fused_rollout`): the whole
-            fixed-horizon episode runs inside one kernel with genomes, env
-            state and activations resident in VMEM (one theta read + one
-            fitness write of HBM traffic per env, vs one carry round-trip
-            per step for the scan engine). Requires ``early_exit=False``,
-            no ``cap_episode``/``obs_normalizer``, a flat ``(pop, dim)``
-            population in :func:`flat_mlp_policy` layout, and a
-            never-terminating env. Initial states still come from
-            ``fused_env.base.reset`` with the same keys as the scan engine,
-            so the two engines are numerics-compatible (pinned by
-            tests/test_kernels.py).
+            episode runs inside one kernel with genomes, env state and
+            activations resident in VMEM (one theta read + one fitness
+            write of HBM traffic per env, vs one carry round-trip per
+            step for the scan engine). Terminating envs are handled by a
+            sticky in-kernel done mask with the standard engine's
+            frozen-episode reward accounting, so fitness matches both
+            ``early_exit`` engine modes. Requires no
+            ``cap_episode``/``obs_normalizer`` and a flat ``(pop, dim)``
+            population in :func:`flat_mlp_policy` layout. Initial states
+            come from ``fused_env.base.reset`` with the same keys as the
+            standard engines, so all engines are numerics-compatible
+            (pinned by tests/test_kernels.py). Built-ins:
+            ``pendulum_soa``, ``cartpole_soa``, ``mountain_car_soa``,
+            ``acrobot_soa`` (kernels/rollout.py).
         fused_tile: environments per Pallas grid cell (multiple of 1024;
             2048 measured best on v5e — PERF_NOTES §8).
         fused_interpret: run the kernel in interpreter mode (None = auto:
@@ -215,11 +222,6 @@ class PolicyRolloutProblem(Problem):
         self.early_exit = early_exit
         self.unroll = unroll
         if fused_env is not None:
-            if early_exit:
-                raise ValueError(
-                    "fused_env requires early_exit=False (the kernel runs a "
-                    "fixed-horizon fori_loop)"
-                )
             if cap_episode is not None or obs_normalizer is not None:
                 raise ValueError(
                     "fused_env cannot be combined with cap_episode or "
