@@ -44,6 +44,7 @@ except ImportError:  # pragma: no cover
 SoAState = Dict[str, jax.Array]
 
 _LANES = 128  # TPU vreg lane width
+_PAD_KEY = "__pad__"  # reserved state plane marking padded (dead) lanes
 
 
 class SoAEnv(NamedTuple):
@@ -66,6 +67,11 @@ class SoAEnv(NamedTuple):
         [SoAState, Tuple[jax.Array, ...]],
         Tuple[SoAState, jax.Array, jax.Array],
     ]
+    # terminating=True runs the kernel loop as a while_loop that exits a
+    # tile as soon as ALL of its envs are done (per-tile early exit —
+    # finer than the generic engine's global all-done test); False keeps
+    # the fori_loop, which pipelines better when episodes never end
+    terminating: bool = True
 
 
 def pendulum_reset_soa(key: jax.Array, n: int) -> SoAState:
@@ -104,6 +110,7 @@ def pendulum_soa(max_steps: int = 200) -> SoAEnv:
         to_soa=lambda s: {"th": s[..., 0], "thdot": s[..., 1]},
         obs_soa=pendulum_obs_soa,
         step_soa=pendulum_step_soa,
+        terminating=False,
     )
 
 
@@ -296,6 +303,7 @@ def _rollout_kernel(
     step_soa: Callable,
     obs_soa: Callable,
     state_keys: Tuple[str, ...],
+    early_stop: bool,
 ):
     # drop the leading episode-block dim: every per-env value in the body
     # is then a uniform 2-D (rows, 128) block, same rank as the theta
@@ -304,10 +312,12 @@ def _rollout_kernel(
     # replicated")
     state = {k: r[0] for k, r in zip(state_keys, state_refs)}
     total0 = jnp.zeros_like(state[state_keys[0]])
-    done0 = jnp.zeros_like(total0)  # sticky float mask (0 = live)
+    # sticky float done mask, seeded from the padding plane so padded
+    # lanes never hold the early-exit while_loop open (a zero-state
+    # padded env may never terminate on its own, e.g. mountain_car)
+    done0 = state.pop(_PAD_KEY)
 
-    def body(_, carry):
-        state, done, total = carry
+    def body(state, done, total):
         obs = obs_soa(state)
         a = _mlp_act(theta_ref, obs, obs_dim, hidden, act_dim)
         state, reward, step_done = step_soa(state, a)
@@ -315,12 +325,30 @@ def _rollout_kernel(
         # terminating step's reward counts, later ones don't. Same-shape
         # where operands: a scalar branch here trips a Mosaic relayout
         # bug ("non-singleton logical dimension is replicated") on the
-        # (1, rows, 128) episode blocks.
+        # episode blocks.
         total = total + jnp.where(done > 0.5, jnp.zeros_like(reward), reward)
         done = jnp.maximum(done, step_done.astype(done.dtype))
         return state, done, total
 
-    _, _, total = jax.lax.fori_loop(0, T, body, (state, done0, total0))
+    if early_stop:
+        # per-tile early exit: uniform-shape vector carries compile fine
+        # (it is MIXED-shape while carries that crash Mosaic)
+        def cond(c):
+            t, _, done, _ = c
+            return (t < T) & jnp.any(done < 0.5)
+
+        def wbody(c):
+            t, state, done, total = c
+            state, done, total = body(state, done, total)
+            return t + 1, state, done, total
+
+        _, _, _, total = jax.lax.while_loop(
+            cond, wbody, (jnp.int32(0), state, done0, total0)
+        )
+    else:
+        _, _, total = jax.lax.fori_loop(
+            0, T, lambda _, c: body(*c), (state, done0, total0)
+        )
     out_ref[0] = total
 
 
@@ -328,7 +356,7 @@ def _rollout_kernel(
     jax.jit,
     static_argnames=(
         "T", "obs_dim", "hidden", "act_dim", "step_soa", "obs_soa", "tile",
-        "episodes", "interpret",
+        "episodes", "early_stop", "interpret",
     ),
 )
 def fused_rollout(
@@ -342,6 +370,7 @@ def fused_rollout(
     obs_soa: Callable = pendulum_obs_soa,
     tile: int = 2048,
     episodes: int = 1,
+    early_stop: bool = False,
     interpret: bool = False,
 ) -> jax.Array:
     """Total episode reward per environment, fully fused.
@@ -385,13 +414,24 @@ def fused_rollout(
             f"init_state has {jax.tree.leaves(init_state)[0].shape[0]} envs, "
             f"expected episodes*n = {episodes * n}"
         )
+    if _PAD_KEY in init_state:
+        raise ValueError(f"state key {_PAD_KEY!r} is reserved")
     pad = (-n) % tile
     n_pad = n + pad
+    init_state = dict(init_state)
+    # padding plane: 1.0 on padded lanes; seeds the kernel's done mask so
+    # padded (zero-state) envs can't hold the early-exit loop open
+    init_state[_PAD_KEY] = jnp.zeros((episodes * n,), dtype=theta.dtype)
     if pad:
         theta = jnp.pad(theta, ((0, pad), (0, 0)))
-        # pad each episode segment so segments stay tile-aligned
+        # pad each episode segment so segments stay tile-aligned; the
+        # padding plane gets 1.0 in the padded tail of every segment
         init_state = {
-            k: jnp.pad(v.reshape(episodes, n), ((0, 0), (0, pad))).reshape(-1)
+            k: jnp.pad(
+                v.reshape(episodes, n),
+                ((0, 0), (0, pad)),
+                constant_values=1.0 if k == _PAD_KEY else 0.0,
+            ).reshape(-1)
             for k, v in init_state.items()
         }
     # every per-env quantity becomes a full (sublane, lane) = (8k, 128m)
@@ -417,6 +457,7 @@ def fused_rollout(
         step_soa=step_soa,
         obs_soa=obs_soa,
         state_keys=state_keys,
+        early_stop=early_stop,
     )
 
     def wrapped(theta_ref, *state_refs_and_out):

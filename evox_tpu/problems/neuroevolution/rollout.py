@@ -153,9 +153,10 @@ class PolicyRolloutProblem(Problem):
             loop overhead for straight-line code XLA can pipeline — a real
             throughput win at large populations. Incompatible with
             ``cap_episode`` (the cap is a traced bound). Ignored by the
-            ``fused_env`` engine, which always runs the full fixed
-            horizon with a done mask (same fitness either way; no early
-            exit — see PERF_NOTES §8's caveat for fast-dying envs).
+            ``fused_env`` engine, which picks its own loop form: per-tile
+            early-exit while_loop for terminating envs, fixed-horizon
+            fori for never-terminating ones (``SoAEnv.terminating``;
+            same fitness either way — PERF_NOTES §8).
         unroll: scan unroll factor for the ``early_exit=False`` path.
         fused_env: an :class:`~evox_tpu.kernels.rollout.SoAEnv` — switches
             ``evaluate`` to the fused Pallas rollout kernel
@@ -332,6 +333,7 @@ class PolicyRolloutProblem(Problem):
             obs_soa=self.fused_env.obs_soa,
             tile=self.fused_tile,
             episodes=ep,
+            early_stop=self.fused_env.terminating,
             interpret=interpret,
         )
         # (ep, pop) episode-major -> (pop, ep) so reduce_fn sees the same
