@@ -14,9 +14,11 @@ layer is a static loop of full-width (rows, 128) VPU fused
 multiply-adds (per-individual matvecs cannot use the MXU — every lane
 carries different weights). HBM sees one weight read and one fitness
 write per env per episode. Termination is a sticky in-kernel done mask
-over a fixed-T ``fori_loop`` (a while_loop with mixed-shape carries
-trips Mosaic layout inference; the masked form costs the
-post-termination steps but compiles everywhere).
+with per-tile early exit: the loop is a ``while_loop`` whose state is
+packed into ONE uniform (rows, tile) block — Mosaic rejects mixed-shape
+while carries, but a single packed carry compiles; never-terminating
+envs can opt out via ``PlaneEnv(terminating=False)`` for the
+better-pipelining fixed-T ``fori_loop``.
 
 Layouts:
 - weights per layer ``(fan_in, fan_out, n)`` — individual in the lane
@@ -69,6 +71,11 @@ class PlaneEnv(NamedTuple):
     step_planes: Callable[
         [PlaneState, jax.Array], Tuple[PlaneState, jax.Array, jax.Array]
     ]
+    # terminating=True: the kernel loop is a while_loop exiting each tile
+    # as soon as all of its envs are done. Mosaic rejects mixed-shape
+    # while carries, so the state planes are packed into ONE
+    # (total_rows, tile) block for the loop and sliced apart each step.
+    terminating: bool = True
 
 
 # ------------------------------------------------------------ chain walker
@@ -262,6 +269,7 @@ def _rollout_mlp_kernel(
     step_planes: Callable,
     obs_planes: Callable,
     state_keys: Tuple[str, ...],
+    early_stop: bool,
 ):
     n_layers = len(sizes) - 1
     w_refs = refs[:n_layers]
@@ -273,11 +281,7 @@ def _rollout_mlp_kernel(
     total0 = jnp.zeros((1, tile), dtype=out_ref.dtype)
     done0 = state.pop("done")  # (1, tile) float 0/1
 
-    # fixed trip count + sticky float done mask (an in-kernel while_loop
-    # with mixed-shape carries trips Mosaic layout inference; the masked
-    # fori costs the post-termination steps but compiles everywhere)
-    def body(_, carry):
-        state, done, total = carry
+    def body(state, done, total):
         obs = obs_planes(state)
         act = _mlp_planes(w_refs, b_refs, obs, sizes)
         state, reward, step_done = step_planes(state, act)
@@ -285,7 +289,56 @@ def _rollout_mlp_kernel(
         done = jnp.maximum(done, step_done.astype(done.dtype))
         return state, done, total
 
-    _, _, total = jax.lax.fori_loop(0, T, body, (state, done0, total0))
+    if early_stop:
+        # per-tile early exit. Mosaic rejects MIXED-shape while carries,
+        # so the whole loop state is packed into ONE (rows, tile) block
+        # and sliced apart each iteration (sublane slices are cheap).
+        keys = [k for k in state_keys if k != "done"]
+        for k in keys:
+            # the packed carry concatenates all planes: a non-uniform
+            # dtype would be silently promoted, diverging from the fori
+            # branch — make the constraint loud instead
+            if state[k].dtype != out_ref.dtype:
+                raise TypeError(
+                    f"early_stop requires all state planes to be "
+                    f"{out_ref.dtype}; plane {k!r} is {state[k].dtype} "
+                    "(use terminating=False or cast in to_planes)"
+                )
+        rows = [state[k].shape[0] for k in keys]
+        offs = [0]
+        for r in rows:
+            offs.append(offs[-1] + r)
+        done_off = offs[-1]
+
+        def pack(state, done, total):
+            return jnp.concatenate(
+                [state[k] for k in keys] + [done, total], axis=0
+            )
+
+        def unpack(big):
+            st = {
+                k: big[o : o + r] for k, o, r in zip(keys, offs[:-1], rows)
+            }
+            return st, big[done_off : done_off + 1], big[done_off + 1 :]
+
+        def cond(c):
+            t, big = c
+            return (t < T) & jnp.any(big[done_off : done_off + 1] < 0.5)
+
+        def wbody(c):
+            t, big = c
+            st, done, total = unpack(big)
+            st, done, total = body(st, done, total)
+            return t + 1, pack(st, done, total)
+
+        _, big = jax.lax.while_loop(
+            cond, wbody, (jnp.int32(0), pack(state, done0, total0))
+        )
+        total = big[done_off + 1 :]
+    else:
+        _, _, total = jax.lax.fori_loop(
+            0, T, lambda _, c: body(*c), (state, done0, total0)
+        )
     out_ref[...] = total
 
 
@@ -293,7 +346,7 @@ def _rollout_mlp_kernel(
     jax.jit,
     static_argnames=(
         "T", "sizes", "step_planes", "obs_planes", "tile", "episodes",
-        "interpret",
+        "early_stop", "interpret",
     ),
 )
 def fused_mlp_rollout(
@@ -306,6 +359,7 @@ def fused_mlp_rollout(
     obs_planes: Callable,
     tile: int = _LANES,
     episodes: int = 1,
+    early_stop: bool = True,
     interpret: bool = False,
 ) -> jax.Array:
     """Total episode reward per env, fully fused, weights VMEM-resident.
@@ -363,6 +417,7 @@ def fused_mlp_rollout(
         step_planes=step_planes,
         obs_planes=obs_planes,
         state_keys=state_keys,
+        early_stop=early_stop,
     )
 
     def wrapped(*refs):

@@ -398,6 +398,7 @@ class PolicyRolloutProblem(Problem):
             obs_planes=self.fused_planes.obs_planes,
             tile=self.fused_planes_tile,
             episodes=ep,
+            early_stop=self.fused_planes.terminating,
             interpret=interpret,
         )
         fitness = self.reduce_fn(totals.reshape(ep, pop_size).T, axis=-1)

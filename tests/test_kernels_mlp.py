@@ -56,16 +56,19 @@ def _walker_setup(n, ep=1, max_steps=12, seed=0):
     return penv, penv.to_planes(env_flat)
 
 
+@pytest.mark.parametrize("early_stop", [True, False], ids=["while", "fori"])
 @pytest.mark.parametrize("n", [5, 128, 150])
-def test_fused_mlp_exact_vs_plane_loop(n):
-    """Tiling, padding, while_loop and weight layout reproduce the plane
-    math exactly (n=5 exercises padding, 150 a ragged final tile)."""
+def test_fused_mlp_exact_vs_plane_loop(n, early_stop):
+    """Tiling, padding, both loop forms and the weight layout reproduce
+    the plane math exactly (n=5 exercises padding, 150 a ragged final
+    tile; early_stop covers the packed-carry while_loop AND the fori
+    fallback for never-terminating envs)."""
     penv, planes0 = _walker_setup(n, max_steps=8)
     weights, biases = _make_params(jax.random.PRNGKey(1), n)
     got = fused_mlp_rollout(
         weights, biases, planes0, T=8, sizes=SIZES,
         step_planes=penv.step_planes, obs_planes=penv.obs_planes,
-        interpret=True,
+        early_stop=early_stop, interpret=True,
     )
     want = _loop_reference(weights, biases, planes0, 8, penv, SIZES)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
