@@ -88,6 +88,16 @@ class LES(Algorithm):
             from .les_meta import load_params
 
             params = load_params()  # None if no bundled artifact
+            if params is None:
+                import warnings
+
+                warnings.warn(
+                    "LES(params='auto'): bundled les_params.npz missing or "
+                    "shape-incompatible; falling back to a RANDOM (untrained) "
+                    "initialization. Pass params explicitly or re-run "
+                    "les_meta training to restore the meta-trained default.",
+                    stacklevel=2,
+                )
         if params is None:
             k1, k2 = jax.random.split(jax.random.PRNGKey(params_seed))
             params = {

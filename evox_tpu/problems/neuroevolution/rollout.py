@@ -228,6 +228,7 @@ class PolicyRolloutProblem(Problem):
                     "fused_env cannot be combined with cap_episode or "
                     "obs_normalizer"
                 )
+            self._check_fused_base(fused_env.base, "fused_env")
         if fused_planes is not None:
             if fused_env is not None:
                 raise ValueError("pass fused_env OR fused_planes, not both")
@@ -236,12 +237,29 @@ class PolicyRolloutProblem(Problem):
                     "fused_planes cannot be combined with cap_episode or "
                     "obs_normalizer"
                 )
+            self._check_fused_base(fused_planes.base, "fused_planes")
         self.fused_env = fused_env
         self.fused_tile = fused_tile
         self.fused_interpret = fused_interpret
         self.fused_planes = fused_planes
         self.fused_planes_tile = fused_planes_tile
         self._fused_policy_checked = False
+
+    def _check_fused_base(self, base, name: str) -> None:
+        """A fused spec built over a *different* env than the constructor's
+        ``env`` would silently evaluate a different workload than the scan
+        engine (T/obs_dim/act_dim come from ``self.env``, step math from the
+        fused spec) — refuse the mismatch up front."""
+        if base is self.env:
+            return
+        for attr in ("obs_dim", "act_dim", "max_steps"):
+            if getattr(base, attr, None) != getattr(self.env, attr):
+                raise ValueError(
+                    f"{name}.base disagrees with env on {attr!r} "
+                    f"({getattr(base, attr, None)} vs "
+                    f"{getattr(self.env, attr)}); build the fused spec "
+                    "over the same EnvSpec passed as env"
+                )
 
     def _check_fused_policy(self, dim: int, hidden: int) -> None:
         """One-time concrete probe: ``self.policy`` must agree with the

@@ -54,6 +54,13 @@ def run_host_pipelined(
         hook_fut = None
         for g in range(n_steps):
             fitness, _ = fut.result()
+            if hook_fut is not None:
+                # surface on_generation errors from generation g-1 BEFORE
+                # advancing the state or submitting generation g+1's eval
+                # (the hook still overlapped generation g's evaluate, which
+                # just completed above — the dominant host-side cost)
+                hook_fut.result()
+                hook_fut = None
             # discard the problem's returned state, exactly like the
             # wf.step external path does (common.py callback_evaluate):
             # host problems keep generation-to-generation state host-side
@@ -64,8 +71,6 @@ def run_host_pipelined(
                 cand, ctx = wf.pipeline_ask(state)
                 fut = eval_pool.submit(wf.problem.evaluate, state.prob, cand)
             if on_generation is not None:
-                if hook_fut is not None:
-                    hook_fut.result()
                 hook_fut = hook_pool.submit(on_generation, g, state, fitness)
         if hook_fut is not None:
             hook_fut.result()
