@@ -50,18 +50,18 @@ class BiGE(GAMOAlgorithm):
     def mate(self, key: jax.Array, state: MOState) -> jax.Array:
         all_live = jnp.ones((self.pop_size,), dtype=bool)
         bi = bi_goals(state.fitness, all_live)
-        bi_rank = non_dominated_sort(bi)
+        bi_rank = non_dominated_sort(bi, mesh=self.mesh)
         return tournament(key, state.population, bi_rank.astype(jnp.float32))
 
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
-        rank = non_dominated_sort(fit)
+        rank = non_dominated_sort(fit, mesh=self.mesh)
         order = jnp.argsort(rank)
         rank = rank[order]
         pop, fit = pop[order], fit[order]
         last_rank = rank[self.pop_size]
         # bi-goal ranking only among the cut front; safer fronts keep rank -1
         bi = bi_goals(fit, rank == last_rank)
-        bi_rank = non_dominated_sort(bi)
+        bi_rank = non_dominated_sort(bi, mesh=self.mesh)
         fin = jnp.where(rank >= last_rank, bi_rank, -1)
         idx = jnp.argsort(fin)[: self.pop_size]
         return pop[idx], fit[idx]

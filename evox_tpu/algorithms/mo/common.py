@@ -41,14 +41,24 @@ def uniform_init(key: jax.Array, lb: jax.Array, ub: jax.Array, pop_size: int) ->
 
 class GAMOAlgorithm(Algorithm):
     """GA-skeleton MO base: subclasses implement ``select(state, merged_pop,
-    merged_fit) -> (pop, fit)`` environmental selection."""
+    merged_fit) -> (pop, fit)`` environmental selection.
 
-    def __init__(self, lb, ub, n_objs: int, pop_size: int):
+    ``mesh``: a ``jax.sharding.Mesh`` with a ``"pop"`` axis. When given,
+    the O(n²) non-dominated sort inside environmental selection (and
+    migration ingest) is row-sharded across the mesh via ``shard_map``
+    (operators/selection/non_dominate.py::_non_dominated_sort_sharded) —
+    multi-chip MO then scales SELECTION as well as evaluation. Results
+    are bit-identical to the replicated sort. Pass the same mesh as the
+    workflow's; it can also be assigned later (``algo.mesh = mesh``)
+    before the first ``tell`` is traced."""
+
+    def __init__(self, lb, ub, n_objs: int, pop_size: int, mesh=None):
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
         self.n_objs = n_objs
         self.pop_size = pop_size
+        self.mesh = mesh
 
     # -- state ----------------------------------------------------------------
     def init(self, key: jax.Array) -> MOState:
@@ -107,7 +117,7 @@ class GAMOAlgorithm(Algorithm):
 
         merged_pop = jnp.concatenate([state.population, pop], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
-        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size)
+        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size, mesh=self.mesh)
         fit_sel = merged_fit[order]
         updates = dict(population=merged_pop[order], fitness=fit_sel)
         if hasattr(state, "rank"):

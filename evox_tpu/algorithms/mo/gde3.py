@@ -17,8 +17,8 @@ from .common import GAMOAlgorithm, MOState
 
 
 class GDE3(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, F: float = 0.5, CR: float = 0.3):
-        super().__init__(lb, ub, n_objs, pop_size)
+    def __init__(self, lb, ub, n_objs, pop_size, F: float = 0.5, CR: float = 0.3, mesh=None):
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         self.F = F
         self.CR = CR
 
@@ -56,5 +56,5 @@ class GDE3(GAMOAlgorithm):
         tri_fit = jnp.where(parent_dom[:, None], jnp.inf, fitness)
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([par_fit, tri_fit], axis=0)
-        pop, fit = non_dominate(merged_pop, merged_fit, self.pop_size)
+        pop, fit = non_dominate(merged_pop, merged_fit, self.pop_size, mesh=self.mesh)
         return state.replace(population=pop, fitness=fit)

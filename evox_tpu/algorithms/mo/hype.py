@@ -81,8 +81,8 @@ class HypEState(MOState):
 
 
 class HypE(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, n_samples: int = 8192):
-        super().__init__(lb, ub, n_objs, pop_size)
+    def __init__(self, lb, ub, n_objs, pop_size, n_samples: int = 8192, mesh=None):
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         self.n_samples = n_samples
 
     def init(self, key: jax.Array) -> HypEState:
@@ -102,7 +102,7 @@ class HypE(GAMOAlgorithm):
         return state.replace(
             fitness=fitness,
             ref_point=ref,
-            rank=non_dominated_sort(fitness).astype(jnp.int32),
+            rank=non_dominated_sort(fitness, mesh=self.mesh).astype(jnp.int32),
         )
 
     def _score(self, key, fit, ref, rank, k):
@@ -125,7 +125,7 @@ class HypE(GAMOAlgorithm):
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
         k_remove = merged_fit.shape[0] - self.pop_size
-        rank = non_dominated_sort(merged_fit)
+        rank = non_dominated_sort(merged_fit, mesh=self.mesh)
         cut_rank = jnp.sort(rank)[self.pop_size]
         score = self._score(k_h, merged_fit, state.ref_point, rank, k_remove)
         # rank-primary, HV tie-break within the cut front

@@ -30,8 +30,8 @@ def ibea_fitness(fit: jax.Array, kappa: float) -> jax.Array:
 
 
 class IBEA(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs: int, pop_size: int, kappa: float = 0.05):
-        super().__init__(lb, ub, n_objs, pop_size)
+    def __init__(self, lb, ub, n_objs: int, pop_size: int, kappa: float = 0.05, mesh=None):
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         self.kappa = kappa
 
     def mate(self, key: jax.Array, state: MOState) -> jax.Array:

@@ -113,5 +113,5 @@ class IMMOEA(Algorithm):
     def tell(self, state, fitness):
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
-        pop, fit = non_dominate(merged_pop, merged_fit, self.pop_size)
+        pop, fit = non_dominate(merged_pop, merged_fit, self.pop_size, mesh=self.mesh)
         return state.replace(population=pop, fitness=fit)

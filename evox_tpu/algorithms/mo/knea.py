@@ -76,8 +76,9 @@ class KnEA(GAMOAlgorithm):
         pop_size: int,
         knee_rate: float = 0.5,
         k_neighbors: int = 3,
+        mesh=None,
     ):
-        super().__init__(lb, ub, n_objs, pop_size)
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         self.knee_rate = knee_rate
         self.k_neighbors = k_neighbors
 
@@ -97,7 +98,7 @@ class KnEA(GAMOAlgorithm):
 
     def init_tell(self, state: KnEAState, fitness: jax.Array) -> KnEAState:
         return state.replace(
-            fitness=fitness, rank=non_dominated_sort(fitness).astype(jnp.int32)
+            fitness=fitness, rank=non_dominated_sort(fitness, mesh=self.mesh).astype(jnp.int32)
         )
 
     def mate(self, key: jax.Array, state: KnEAState) -> jax.Array:
@@ -118,7 +119,7 @@ class KnEA(GAMOAlgorithm):
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
         n = merged_fit.shape[0]
 
-        rank = non_dominated_sort(merged_fit)
+        rank = non_dominated_sort(merged_fit, mesh=self.mesh)
         order = jnp.argsort(rank)
         rank = rank[order]
         pop = merged_pop[order]

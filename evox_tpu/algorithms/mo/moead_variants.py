@@ -181,7 +181,7 @@ class MOEADM2M(Algorithm):
 
         # per-region: keep S best by (rank, -crowding) among members; regions
         # short on members borrow the globally best leftovers
-        rank = non_dominated_sort(merged_fit)
+        rank = non_dominated_sort(merged_fit, mesh=self.mesh)
         crowd = crowding_distance(merged_fit)
         n2 = merged_fit.shape[0]
 

@@ -45,7 +45,7 @@ class NSGA2(GAMOAlgorithm):
     def init_tell(self, state: NSGA2State, fitness: jax.Array) -> NSGA2State:
         return state.replace(
             fitness=fitness,
-            rank=non_dominated_sort(fitness),
+            rank=non_dominated_sort(fitness, mesh=self.mesh),
             crowd=crowding_distance(fitness),
         )
 
@@ -56,7 +56,7 @@ class NSGA2(GAMOAlgorithm):
     def tell(self, state: NSGA2State, fitness: jax.Array) -> NSGA2State:
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
-        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size)
+        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size, mesh=self.mesh)
         fit_sel = merged_fit[order]
         return state.replace(
             population=merged_pop[order],

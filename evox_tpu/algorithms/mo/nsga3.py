@@ -44,8 +44,8 @@ def _normalize(fit: jax.Array) -> jax.Array:
 
 
 class NSGA3(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs: int, pop_size: int):
-        super().__init__(lb, ub, n_objs, pop_size)
+    def __init__(self, lb, ub, n_objs: int, pop_size: int, mesh=None):
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         refs, n = UniformSampling(pop_size, n_objs)()
         self.refs = refs / jnp.linalg.norm(refs, axis=1, keepdims=True)
         self.pop_size = n
@@ -53,7 +53,7 @@ class NSGA3(GAMOAlgorithm):
     def select(self, state: MOState, pop: jax.Array, fit: jax.Array):
         n = fit.shape[0]
         k = self.pop_size
-        rank = non_dominated_sort(fit)
+        rank = non_dominated_sort(fit, mesh=self.mesh)
         order = jnp.argsort(rank, stable=True)
         last_rank = rank[order[k - 1]]
 

@@ -38,8 +38,9 @@ class RVEA(GAMOAlgorithm):
         alpha: float = 2.0,
         fr: float = 0.1,
         max_gen: int = 100,
+        mesh=None,
     ):
-        super().__init__(lb, ub, n_objs, pop_size)
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         v, n = UniformSampling(pop_size, n_objs)()
         self.v0 = v / jnp.linalg.norm(v, axis=1, keepdims=True)
         self.pop_size = n

@@ -28,8 +28,8 @@ def _sde_density(fit: jax.Array) -> jax.Array:
 
 
 class SRA(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, pc: float = None, sweeps: int = None):
-        super().__init__(lb, ub, n_objs, pop_size)
+    def __init__(self, lb, ub, n_objs, pop_size, pc: float = None, sweeps: int = None, mesh=None):
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         # probability of comparing by indicator-1; None = the paper's
         # per-generation draw from U(0.4, 0.6) (reference sra.py:184)
         self.pc = pc

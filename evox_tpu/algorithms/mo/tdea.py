@@ -16,8 +16,8 @@ from .nsga3 import _normalize
 
 
 class TDEA(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, theta: float = 5.0):
-        super().__init__(lb, ub, n_objs, pop_size)
+    def __init__(self, lb, ub, n_objs, pop_size, theta: float = 5.0, mesh=None):
+        super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         refs, n = UniformSampling(pop_size, n_objs)()
         self.refs = refs / jnp.linalg.norm(refs, axis=1, keepdims=True)
         # boundary weight vectors (single nonzero component) use a huge
@@ -47,6 +47,6 @@ class TDEA(GAMOAlgorithm):
         )
         theta_rank = jnp.zeros((n,), jnp.int32).at[order].set(pos_in_cluster)
         # Pareto rank as primary, theta-rank to fill niches evenly
-        rank = non_dominated_sort(fit)
+        rank = non_dominated_sort(fit, mesh=self.mesh)
         idx = jnp.lexsort((pbi, theta_rank, rank))[: self.pop_size]
         return pop[idx], fit[idx]

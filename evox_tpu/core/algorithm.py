@@ -41,6 +41,12 @@ class Algorithm:
     dispatch them on generation 0 when present.
     """
 
+    # Opt-in mesh for algorithms whose internal O(n²) machinery (e.g. MO
+    # environmental selection) can shard across a device mesh. None =
+    # replicated computation; GAMOAlgorithm exposes it as a constructor
+    # argument, any other algorithm accepts plain attribute assignment.
+    mesh = None
+
     def init(self, key: jax.Array) -> AlgorithmState:
         raise NotImplementedError
 

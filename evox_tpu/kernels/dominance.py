@@ -85,6 +85,23 @@ def _dominance_pack_kernel(x_ref, yt_ref, out_ref, *, m: int, tile_i: int, tile_
         )
 
 
+def pack_dominator_rows(dom: jax.Array, n_words: int) -> jax.Array:
+    """Bit-pack a boolean ``(rows, n)`` dominator matrix into ``(n_words,
+    n)`` uint32 words (bit ``k`` of word ``w`` <- row ``32w + k``) via the
+    reshape-multiply-reduce path. Shared by the XLA fallback below and the
+    mesh-sharded sort's per-device slab build."""
+    pad = n_words * 32 - dom.shape[0]
+    bit_weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(
+        jnp.pad(dom, ((0, pad), (0, 0)))
+        .reshape(n_words, 32, dom.shape[1])
+        .astype(jnp.uint32)
+        * bit_weights[None, :, None],
+        axis=1,
+        dtype=jnp.uint32,
+    )
+
+
 def packed_dominance_reference(
     fitness: jax.Array, n_words: Optional[int] = None
 ) -> Tuple[jax.Array, jax.Array]:
@@ -98,16 +115,7 @@ def packed_dominance_reference(
     if n_words is None:
         n_words = (n + 31) // 32
     dom = dominate_relation(fitness, fitness)
-    pad = n_words * 32 - n
-    bit_weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
-    packed = jnp.sum(
-        jnp.pad(dom, ((0, pad), (0, 0)))
-        .reshape(n_words, 32, n)
-        .astype(jnp.uint32)
-        * bit_weights[None, :, None],
-        axis=1,
-        dtype=jnp.uint32,
-    )
+    packed = pack_dominator_rows(dom, n_words)
     count = jnp.sum(dom, axis=0, dtype=jnp.int32)
     return packed, count
 

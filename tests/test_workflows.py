@@ -132,16 +132,52 @@ def test_shard_map_eval_island_matches_gspmd():
 
 
 def test_shard_map_eval_island_mo():
-    """shard_map island with (pop, m) fitness and a stateful MO selection."""
+    """shard_map island with (pop, m) fitness and a stateful MO selection:
+    the sharded run must MATCH single-device, not merely stay finite."""
     from evox_tpu.algorithms.mo import NSGA2
     from evox_tpu.problems.numerical import ZDT1
 
     mesh = create_mesh()
-    algo = NSGA2(jnp.zeros(6), jnp.ones(6), n_objs=2, pop_size=32)
-    wf = StdWorkflow(algo, ZDT1(n_dim=6), mesh=mesh, eval_shard_map=True)
-    state = wf.init(jax.random.PRNGKey(12))
-    state = wf.run(state, 10)
-    assert bool(jnp.isfinite(state.algo.fitness).all())
+
+    def run(mesh_arg, island):
+        algo = NSGA2(jnp.zeros(6), jnp.ones(6), n_objs=2, pop_size=32,
+                     mesh=mesh_arg)
+        wf = StdWorkflow(algo, ZDT1(n_dim=6), mesh=mesh_arg,
+                         eval_shard_map=island)
+        state = wf.init(jax.random.PRNGKey(12))
+        state = wf.run(state, 10)
+        return np.asarray(state.algo.fitness)
+
+    f_island = run(mesh, True)
+    f_single = run(None, False)
+    np.testing.assert_allclose(f_island, f_single, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_mo_selection_matches_single_device():
+    """NSGA-II/LSMOP1 with BOTH evaluation and the O(n²) environmental
+    selection sharded over the 8-device mesh (algorithms/mo/common.py mesh
+    arg -> operators/selection/non_dominate.py sharded sort) must match the
+    single-device run to <=1e-5 (VERDICT r3 task 1 done-criterion; exact
+    equality expected since ranks are integer-identical)."""
+    from evox_tpu.algorithms.mo import NSGA2
+    from evox_tpu.problems.numerical import LSMOP1
+
+    mesh = create_mesh()
+    d, m, pop = 30, 3, 64
+    prob = LSMOP1(d=d, m=m)
+
+    def run(mesh_arg):
+        algo = NSGA2(lb=jnp.zeros(d), ub=jnp.ones(d), n_objs=m,
+                     pop_size=pop, mesh=mesh_arg)
+        wf = StdWorkflow(algo, prob, mesh=mesh_arg, num_objectives=m)
+        st = wf.init(jax.random.PRNGKey(0))
+        st = wf.run(st, 10)
+        return np.asarray(st.algo.fitness), np.asarray(st.algo.population)
+
+    f_s, p_s = run(mesh)
+    f_r, p_r = run(None)
+    np.testing.assert_allclose(f_s, f_r, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(p_s, p_r, rtol=1e-5, atol=1e-5)
 
 
 def test_uneven_pop_sharding_policy():
