@@ -5,6 +5,23 @@ Each generation, particles are randomly paired; each pair's loser learns
 from its winner and from the swarm mean, and only the updated losers are
 re-evaluated (half the population per generation) — the ``init_ask`` /
 ``init_tell`` first-generation pattern of the reference.
+
+TPU-first data movement: the reference formulation (and this module's
+round-3 version) indexes winners/losers through ``students``/``teachers``
+index vectors — five random row-gathers in ``ask`` plus three scatters in
+``tell`` per generation. A population is a *set*: CSO never needs stable
+row identity, so this version permutes the population ONCE into
+pair-major layout (`pop[perm]` — the single gather), selects winners and
+losers with elementwise ``where`` on the two halves, and writes the next
+generation as ``concat(winners, updated_losers)`` — pure streaming, zero
+scatters. The swarm ``center`` falls out of the same gathered pass (the
+permuted population IS the population), so the separate full-population
+mean pass disappears too. Distributionally identical to the reference
+update
+(same pairing law, same learning rule, same tie-breaking: on equal
+fitness the second row of the pair wins). The algorithm is
+HBM-streaming-bound; see PERF_NOTES §12 for the measured traffic budget
+and the shared-chip streaming roofline that caps this leg.
 """
 
 from __future__ import annotations
@@ -28,7 +45,15 @@ class CSOState(PyTreeNode):
     population: jax.Array = field(sharding=P(POP_AXIS))
     fitness: jax.Array = field(sharding=P(POP_AXIS))
     velocity: jax.Array = field(sharding=P(POP_AXIS))
-    students: jax.Array = field(sharding=P())  # half-pop indices: replicate
+    # pair-major intermediates carried from ask to tell (half-pop leading
+    # axis). Inside a fused step they are XLA temporaries. (An empty-(0,d)
+    # post-tell form that would drop them from the loop carry was
+    # prototyped — ~1.1x on the streaming-bound bench leg — but rejected:
+    # wrappers that run ask under lax.cond (containers/clustered.py:169)
+    # need the state STRUCTURE identical on both branches.)
+    winners: jax.Array = field(sharding=P(POP_AXIS))
+    winner_velocity: jax.Array = field(sharding=P(POP_AXIS))
+    winner_fitness: jax.Array = field(sharding=P(POP_AXIS))
     candidates: jax.Array = field(sharding=P(POP_AXIS))
     candidate_velocity: jax.Array = field(sharding=P(POP_AXIS))
     key: jax.Array = field(sharding=P())
@@ -52,7 +77,9 @@ class CSO(Algorithm):
             population=pop,
             fitness=jnp.full((self.pop_size,), jnp.inf),
             velocity=jnp.zeros((self.pop_size, self.dim)),
-            students=jnp.zeros((half,), dtype=jnp.int32),
+            winners=jnp.zeros((half, self.dim)),
+            winner_velocity=jnp.zeros((half, self.dim)),
+            winner_fitness=jnp.full((half,), jnp.inf),
             candidates=jnp.zeros((half, self.dim)),
             candidate_velocity=jnp.zeros((half, self.dim)),
             key=k_state,
@@ -68,32 +95,48 @@ class CSO(Algorithm):
     def ask(self, state: CSOState) -> Tuple[jax.Array, CSOState]:
         key, k_pair, k1, k2, k3 = jax.random.split(state.key, 5)
         half = self.pop_size // 2
-        perm = jax.random.permutation(k_pair, self.pop_size).reshape(2, half)
-        f_a, f_b = state.fitness[perm[0]], state.fitness[perm[1]]
-        a_wins = f_a < f_b
-        teachers = jnp.where(a_wins, perm[0], perm[1])
-        students = jnp.where(a_wins, perm[1], perm[0])
-        center = jnp.mean(state.population, axis=0, keepdims=True)
+        # the ONE gather: population/velocity/fitness into pair-major
+        # layout (pair i = permuted rows i and half+i — the block-split
+        # pairing, equal in law to any fixed pairing of a uniform perm)
+        perm = jax.random.permutation(k_pair, self.pop_size)
+        pair_x = state.population[perm].reshape(2, half, self.dim)
+        pair_v = state.velocity[perm].reshape(2, half, self.dim)
+        pair_f = state.fitness[perm].reshape(2, half)
+        # swarm center: the permuted population is the population, so the
+        # mean fuses into this same pass instead of a separate full read
+        center = (
+            jnp.sum(pair_x[0], axis=0) + jnp.sum(pair_x[1], axis=0)
+        )[None, :] * (1.0 / self.pop_size)
+        a_wins = pair_f[0] < pair_f[1]
+        w = a_wins[:, None]
+        x_w = jnp.where(w, pair_x[0], pair_x[1])
+        x_s = jnp.where(w, pair_x[1], pair_x[0])
+        v_s = jnp.where(w, pair_v[1], pair_v[0])
+        f_w = jnp.where(a_wins, pair_f[0], pair_f[1])
+        v_w = jnp.where(w, pair_v[0], pair_v[1])
         r1 = jax.random.uniform(k1, (half, self.dim))
         r2 = jax.random.uniform(k2, (half, self.dim))
         r3 = jax.random.uniform(k3, (half, self.dim))
-        x_s = state.population[students]
-        new_v = (
-            r1 * state.velocity[students]
-            + r2 * (state.population[teachers] - x_s)
-            + self.phi * r3 * (center - x_s)
-        )
+        new_v = r1 * v_s + r2 * (x_w - x_s) + self.phi * r3 * (center - x_s)
         candidates = jnp.clip(x_s + new_v, self.lb, self.ub)
         return candidates, state.replace(
-            students=students,
+            winners=x_w,
+            winner_velocity=v_w,
+            winner_fitness=f_w,
             candidates=candidates,
             candidate_velocity=new_v,
             key=key,
         )
 
     def tell(self, state: CSOState, fitness: jax.Array) -> CSOState:
+        # streaming writes only: the next generation's row order is
+        # (winners ‖ updated losers) — a set-preserving relabeling, which
+        # the next ask's fresh uniform permutation makes distributionally
+        # identical to the reference's in-place scatter update
         return state.replace(
-            population=state.population.at[state.students].set(state.candidates),
-            velocity=state.velocity.at[state.students].set(state.candidate_velocity),
-            fitness=state.fitness.at[state.students].set(fitness),
+            population=jnp.concatenate([state.winners, state.candidates]),
+            velocity=jnp.concatenate(
+                [state.winner_velocity, state.candidate_velocity]
+            ),
+            fitness=jnp.concatenate([state.winner_fitness, fitness]),
         )

@@ -133,34 +133,50 @@ def chain_walker_planes(**kwargs) -> PlaneEnv:
             [zero, f_link], axis=0
         )
 
-    def _forces(px, py, vx, vy, act):
+    def _ground(py, vy):
+        # action-independent contact normal force (same split as the AoS
+        # engine's _ground — the obs path needs only this)
+        depth = jnp.maximum(-py, 0.0)
+        contact = (depth > 0.0).astype(py.dtype)
+        f_n = ground_stiffness * depth - ground_damping * vy * contact
+        return jnp.maximum(f_n, 0.0) * contact
+
+    def _forces(px, py, vx, vy, scaled_act):
+        # scaled_act = tanh(act) * torque_scale, hoisted by the caller
+        # (substep-invariant); rod directions via one rsqrt instead of
+        # sqrt + three divides — mirrors walker.py::_forces exactly
         fx = jnp.zeros_like(px)
         fy = jnp.full_like(py, -gravity)
 
         dx = px[1:] - px[:-1]
         dy = py[1:] - py[:-1]
-        dist = jnp.sqrt(dx * dx + dy * dy + 1e-12)
-        ux, uy = dx / dist, dy / dist
+        dd = dx * dx + dy * dy + 1e-12
+        inv = jax.lax.rsqrt(dd)
+        dist = dd * inv
+        ux, uy = dx * inv, dy * inv
         rel_v = (vx[1:] - vx[:-1]) * ux + (vy[1:] - vy[:-1]) * uy
         mag = rod_stiffness * (dist - rod_length) + rod_damping * rel_v
         fx = fx + _pad_ends(mag * ux)
         fy = fy + _pad_ends(mag * uy)
 
-        a = jnp.tanh(act) * torque_scale  # (act_dim, tile)
         tq = jnp.concatenate(
-            [a, jnp.zeros((n_links - act_dim,) + a.shape[1:], a.dtype)], axis=0
+            [
+                scaled_act,
+                jnp.zeros(
+                    (n_links - act_dim,) + scaled_act.shape[1:],
+                    scaled_act.dtype,
+                ),
+            ],
+            axis=0,
         )
-        coef = tq / jnp.maximum(dist, 1e-6)
+        coef = tq * jnp.minimum(inv, 1e6)
         fx = fx + _pad_ends(coef * -uy)
         fy = fy + _pad_ends(coef * ux)
 
-        depth = jnp.maximum(-py, 0.0)
-        contact = (depth > 0.0).astype(px.dtype)
-        f_n = ground_stiffness * depth - ground_damping * vy * contact
-        f_n = jnp.maximum(f_n, 0.0) * contact
+        f_n = _ground(py, vy)
         lim = jnp.abs(vx) * 50.0
         f_t = -jnp.clip(friction * f_n * jnp.sign(vx), -lim, lim)
-        return fx + f_t, fy + f_n, f_n
+        return fx + f_t, fy + f_n
 
     def obs_planes(s: PlaneState) -> jax.Array:
         px, py, vx, vy = s["px"], s["py"], s["vx"], s["vy"]
@@ -168,14 +184,16 @@ def chain_walker_planes(**kwargs) -> PlaneEnv:
         rel_y = py - py[:1]
         dx = px[1:] - px[:-1]
         dy = py[1:] - py[:-1]
-        dist = jnp.sqrt(dx * dx + dy * dy + 1e-12)
-        strain = dist / rod_length - 1.0
-        ang_cos = dx / dist
-        ang_sin = dy / dist
+        dd = dx * dx + dy * dy + 1e-12
+        inv = jax.lax.rsqrt(dd)  # one rsqrt replaces sqrt + three divides
+        dist = dd * inv
+        strain = dist * (1.0 / rod_length) - 1.0
+        ang_cos = dx * inv
+        ang_sin = dy * inv
         rvx = vx[1:] - vx[:-1]
         rvy = vy[1:] - vy[:-1]
-        ang_vel = (dx * rvy - dy * rvx) / (dist * dist)
-        _, _, f_n = _forces(px, py, vx, vy, s["pa"])
+        ang_vel = (dx * rvy - dy * rvx) * (inv * inv)
+        f_n = _ground(py, vy)  # action-independent part of _forces
         tile = px.shape[-1]
         # interleave (m0x, m0y, m1x, ...) to match pos.reshape(-1)
         rel = jnp.stack([rel_x, rel_y], axis=1).reshape(2 * n_masses, tile)
@@ -206,10 +224,12 @@ def chain_walker_planes(**kwargs) -> PlaneEnv:
 
     def step_planes(s: PlaneState, act: jax.Array):
         px, py, vx, vy = s["px"], s["py"], s["vx"], s["vy"]
+        ta = jnp.tanh(act)  # substep-invariant: hoisted out of the loop
+        scaled_act = ta * torque_scale
 
         def substep(_, c):
             px, py, vx, vy = c
-            fx, fy, _ = _forces(px, py, vx, vy, act)
+            fx, fy = _forces(px, py, vx, vy, scaled_act)
             vx = vx + h * fx
             vy = vy + h * fy
             return px + h * vx, py + h * vy, vx, vy
@@ -218,7 +238,6 @@ def chain_walker_planes(**kwargs) -> PlaneEnv:
             0, substeps, substep, (px, py, vx, vy)
         )
         com_vx = jnp.mean(vx, axis=0, keepdims=True)  # (1, tile)
-        ta = jnp.tanh(act)
         ctrl = 0.01 * jnp.sum(ta * ta, axis=0, keepdims=True)
         reward = com_vx + 1.0 - ctrl
         head_y = py[-1:]
@@ -247,15 +266,21 @@ def chain_walker_planes(**kwargs) -> PlaneEnv:
 
 def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes) -> jax.Array:
     """(act_dim, tile) actions; per-individual matvecs as static loops of
-    full-width (fan_out, tile) FMAs (weights differ per lane -> no MXU)."""
+    full-width (fan_out, tile) FMAs (weights differ per lane -> no MXU).
+
+    Weight planes may be bf16 (``fused_mlp_rollout(weight_dtype=...)``):
+    each slice is widened to f32 at load and the accumulator stays f32 —
+    the inner loop streams the weight planes from VMEM every env step, so
+    at humanoid scale the kernel is VMEM-bandwidth-bound and halving the
+    resident bytes is a direct speedup (measured: see PERF_NOTES §11)."""
     h = obs
     n_layers = len(sizes) - 1
     for li in range(n_layers):
         fan_in, fan_out = sizes[li], sizes[li + 1]
-        acc = b_refs[li][...]  # (fan_out, tile)
+        acc = b_refs[li][...].astype(jnp.float32)  # (fan_out, tile)
         w = w_refs[li]
         for k in range(fan_in):
-            acc = acc + h[k : k + 1] * w[k]
+            acc = acc + h[k : k + 1] * w[k].astype(jnp.float32)
         h = jnp.tanh(acc) if li < n_layers - 1 else acc
     return h
 
@@ -346,7 +371,7 @@ def _rollout_mlp_kernel(
     jax.jit,
     static_argnames=(
         "T", "sizes", "step_planes", "obs_planes", "tile", "episodes",
-        "early_stop", "interpret",
+        "early_stop", "interpret", "weight_dtype",
     ),
 )
 def fused_mlp_rollout(
@@ -361,6 +386,7 @@ def fused_mlp_rollout(
     episodes: int = 1,
     early_stop: bool = True,
     interpret: bool = False,
+    weight_dtype: Any = None,
 ) -> jax.Array:
     """Total episode reward per env, fully fused, weights VMEM-resident.
 
@@ -373,10 +399,18 @@ def fused_mlp_rollout(
             done mask.
         T / sizes: horizon and MLP layer sizes (obs, h1, ..., act).
         tile: individuals per grid cell (multiple of 128; default 128 —
-            the f32 VMEM budget for the default walker shape).
+            the f32 VMEM budget for the default walker shape; bf16
+            residency fits 256).
+        weight_dtype: VMEM residency dtype for the weight/bias planes
+            (e.g. ``jnp.bfloat16``); None keeps the input dtype. The MLP
+            accumulator is always f32 and all env math stays f32 — only
+            the resident policy planes narrow. At humanoid scale the
+            inner loop re-streams the weight planes from VMEM every env
+            step, so bf16 both halves that bandwidth (the kernel's
+            roofline) and doubles the per-tile policy budget.
 
     Returns:
-        ``(episodes * n,)`` total rewards, episode-major.
+        ``(episodes * n,)`` total rewards, episode-major (always f32).
     """
     if not (_HAS_PLTPU or interpret):
         raise RuntimeError(
@@ -386,6 +420,9 @@ def fused_mlp_rollout(
         raise ValueError(f"tile must be a multiple of {_LANES}, got {tile}")
     n_layers = len(sizes) - 1
     assert len(weights) == n_layers and len(biases) == n_layers
+    if weight_dtype is not None:
+        weights = tuple(w.astype(weight_dtype) for w in weights)
+        biases = tuple(b.astype(weight_dtype) for b in biases)
     n = weights[0].shape[-1]
     pad = (-n) % tile
     n_pad = n + pad
@@ -445,18 +482,20 @@ def fused_mlp_rollout(
         # weights — raise it (v5e VMEM is far larger than the default cap)
         from jax.experimental.pallas import tpu as pltpu
 
+        w_item = weights[0].dtype.itemsize
         per_cell = sum(
-            w.shape[0] * w.shape[1] * tile * 4 for w in weights
-        ) + sum(b.shape[0] * tile * 4 for b in biases)
+            w.shape[0] * w.shape[1] * tile * w_item for w in weights
+        ) + sum(b.shape[0] * tile * w_item for b in biases)
         kwargs["compiler_params"] = pltpu.CompilerParams(
             vmem_limit_bytes=min(2 * per_cell + 8 * 1024 * 1024, 100 * 2**20)
         )
+    out_dtype = state_3d[state_keys[0]].dtype  # env-math dtype (f32)
     total = pl.pallas_call(
         wrapped,
         grid=(episodes, blocks),
         in_specs=w_specs + b_specs + s_specs,
         out_specs=pl.BlockSpec((1, tile), lambda e, b: (e, b)),
-        out_shape=jax.ShapeDtypeStruct((episodes, n_pad), weights[0].dtype),
+        out_shape=jax.ShapeDtypeStruct((episodes, n_pad), out_dtype),
         interpret=interpret,
         **kwargs,
     )(*weights, *biases, *state_3d.values())

@@ -31,6 +31,28 @@ class EvalMonitorState(PyTreeNode):
     topk_fitness: Optional[jax.Array]  # (k,) or (cap, m) raw user-direction
     topk_solution: Optional[Any]
     pf_count: Optional[jax.Array]
+    # device-side generation-history ring buffer (history_capacity > 0):
+    hist_fit: Optional[jax.Array] = None  # (K, width[, m]) inf-padded
+    hist_sol: Optional[Any] = None  # (K, width, ...) when history_solutions
+    hist_len: Optional[jax.Array] = None  # (K,) int32 valid rows per slot
+    hist_count: Optional[jax.Array] = None  # () int32 total generations seen
+
+
+# Backends whose runtimes cannot execute host callbacks (io_callback /
+# pure_callback): the tunneled axon TPU plugin. full_*_history relies on
+# io_callback, so it must fail loudly at trace time there instead of
+# hanging inside the runtime (measured: the callback never completes).
+# The plugin reports platform "tpu"; its identity only shows in the PJRT
+# client's platform_version string ("axon x.y.z; ...").
+_CALLBACK_LESS_MARKERS = ("axon",)
+
+
+def _default_backend_supports_callbacks() -> bool:
+    try:
+        version = getattr(jax.devices()[0].client, "platform_version", "")
+    except Exception:  # pragma: no cover - backend probing must never fail
+        return True
+    return not any(m in version for m in _CALLBACK_LESS_MARKERS)
 
 
 class EvalMonitor(Monitor):
@@ -38,8 +60,23 @@ class EvalMonitor(Monitor):
 
     Single-objective: a ``topk`` elite buffer. Multi-objective: a running
     Pareto archive of capacity ``pf_capacity`` (set ``multi_obj=True``).
-    ``full_fit_history`` / ``full_sol_history`` stream every generation to
-    host memory (outside jit) for offline analysis / plotting.
+
+    Generation history comes in two forms:
+
+    - ``full_fit_history`` / ``full_sol_history``: unbounded, streamed to
+      HOST memory via ``io_callback`` (the reference's design,
+      eval_monitor.py:98-162). Requires a backend with host-callback
+      support — NOT the tunneled axon TPU plugin (raises at trace time
+      there).
+    - ``history_capacity=K``: a fixed-capacity on-DEVICE ring buffer of
+      the last ``K`` generations' fitness (and solutions with
+      ``history_solutions=True``) inside the monitor's pytree state —
+      zero host sync, works on every backend including callback-less
+      ones. When more than ``K`` generations run, the oldest slots are
+      overwritten (ring semantics); per-slot batch widths are tracked so
+      variable evaluation sizes (e.g. CSO's full-then-half pattern) read
+      back exactly. Rows wider than the first generation's batch raise
+      at trace time (the buffer is sized by the first generation).
     """
 
     def __init__(
@@ -49,12 +86,18 @@ class EvalMonitor(Monitor):
         pf_capacity: int = 1024,
         full_fit_history: bool = False,
         full_sol_history: bool = False,
+        history_capacity: int = 0,
+        history_solutions: bool = False,
     ):
         self.topk = topk
         self.multi_obj = multi_obj
         self.pf_capacity = pf_capacity
         self.full_fit_history = full_fit_history
         self.full_sol_history = full_sol_history
+        self.history_capacity = history_capacity
+        self.history_solutions = history_solutions
+        if history_solutions and not history_capacity:
+            raise ValueError("history_solutions requires history_capacity > 0")
         self.fitness_history: list = []
         self.solution_history: list = []
         self.opt_direction = jnp.ones((1,), dtype=jnp.float32)
@@ -71,11 +114,74 @@ class EvalMonitor(Monitor):
     def post_eval(self, mstate: EvalMonitorState, cand: Any, fitness: jax.Array) -> EvalMonitorState:
         if self.full_fit_history or self.full_sol_history:
             self._record_history(cand, fitness)
+        hist = {}
+        if self.history_capacity:
+            hist = self._update_device_history(mstate, cand, fitness)
         if fitness.ndim == 1 and not self.multi_obj:
-            return self._update_so(mstate, cand, fitness)
-        return self._update_mo(mstate, cand, fitness)
+            return self._update_so(mstate, cand, fitness).replace(**hist)
+        return self._update_mo(mstate, cand, fitness).replace(**hist)
+
+    # ------------------------------------------- device-side history ring
+    def _update_device_history(self, mstate, cand, fitness) -> dict:
+        K = self.history_capacity
+        if mstate.hist_fit is None:
+            width = fitness.shape[0]
+            hist_fit = jnp.full((K, width) + fitness.shape[1:], jnp.inf, fitness.dtype)
+            hist_sol = (
+                jax.tree.map(
+                    lambda x: jnp.zeros((K, width) + x.shape[1:], x.dtype), cand
+                )
+                if self.history_solutions
+                else None
+            )
+            hist_len = jnp.zeros((K,), dtype=jnp.int32)
+            count = jnp.zeros((), dtype=jnp.int32)
+        else:
+            hist_fit, hist_sol = mstate.hist_fit, mstate.hist_sol
+            hist_len, count = mstate.hist_len, mstate.hist_count
+            width = hist_fit.shape[1]
+        n = fitness.shape[0]
+        if n > width:
+            raise ValueError(
+                f"history ring buffer was sized by the first generation "
+                f"(batch {width}); cannot record a larger batch ({n}). "
+                "Evaluate the widest batch first or disable history_capacity."
+            )
+        row = jnp.pad(
+            fitness,
+            ((0, width - n),) + ((0, 0),) * (fitness.ndim - 1),
+            constant_values=jnp.inf,
+        )
+        slot = count % K
+        hist_fit = jax.lax.dynamic_update_index_in_dim(hist_fit, row, slot, 0)
+        if hist_sol is not None:
+            hist_sol = jax.tree.map(
+                lambda buf, c: jax.lax.dynamic_update_index_in_dim(
+                    buf,
+                    jnp.pad(c, ((0, width - n),) + ((0, 0),) * (c.ndim - 1)),
+                    slot,
+                    0,
+                ),
+                hist_sol,
+                cand,
+            )
+        hist_len = hist_len.at[slot].set(n)
+        return dict(
+            hist_fit=hist_fit,
+            hist_sol=hist_sol,
+            hist_len=hist_len,
+            hist_count=count + 1,
+        )
 
     def _record_history(self, cand: Any, fitness: jax.Array) -> None:
+        if not _default_backend_supports_callbacks():
+            raise RuntimeError(
+                "full_fit_history/full_sol_history need host callbacks, "
+                "which this backend (axon-tunneled TPU) does not support; "
+                "use EvalMonitor(history_capacity=K) for an on-device "
+                "generation-history ring buffer instead"
+            )
+
         def append(fit, sol):
             if self.full_fit_history:
                 self.fitness_history.append(fit)
@@ -190,3 +296,29 @@ class EvalMonitor(Monitor):
     def get_solution_history(self) -> list:
         jax.effects_barrier()
         return self.solution_history
+
+    # ----------------------------------------- device-history ring getters
+    def _ring_slots(self, mstate: EvalMonitorState):
+        count, K = int(mstate.hist_count), self.history_capacity
+        n = min(count, K)
+        return [(i % K) for i in range(count - n, count)]
+
+    def get_device_fitness_history(self, mstate: EvalMonitorState) -> list:
+        """The last ``min(count, history_capacity)`` generations' fitness,
+        chronological, each sliced to its true batch width. Eager (host)
+        utility; for jit-side access read ``mstate.hist_fit`` /
+        ``hist_len`` / ``hist_count`` directly (ring layout, inf-padded)."""
+        if mstate.hist_fit is None:
+            return []
+        return [
+            mstate.hist_fit[s][: int(mstate.hist_len[s])]
+            for s in self._ring_slots(mstate)
+        ]
+
+    def get_device_solution_history(self, mstate: EvalMonitorState) -> list:
+        if mstate.hist_sol is None:
+            return []
+        return [
+            jax.tree.map(lambda x: x[s][: int(mstate.hist_len[s])], mstate.hist_sol)
+            for s in self._ring_slots(mstate)
+        ]

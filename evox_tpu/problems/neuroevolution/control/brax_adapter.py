@@ -34,27 +34,27 @@ def brax_env(
         problem = PolicyRolloutProblem(policy, env, num_episodes=4)
     """
     try:
-        from brax import envs as brax_envs  # pragma: no cover - optional dep
-    except ImportError as e:  # pragma: no cover
+        from brax import envs as brax_envs
+    except ImportError as e:
         raise ImportError(
             "brax is not installed; use the built-in pure-JAX control envs "
             "(evox_tpu.problems.neuroevolution.control.envs) instead"
         ) from e
 
-    env = brax_envs.get_environment(env_name=env_name, backend=backend)  # pragma: no cover
+    env = brax_envs.get_environment(env_name=env_name, backend=backend)
 
-    def reset(key):  # pragma: no cover - exercised only with brax installed
+    def reset(key):
         return env.reset(key)
 
-    def obs(state):  # pragma: no cover
+    def obs(state):
         return state.obs
 
-    def step(state, action):  # pragma: no cover
+    def step(state, action):
         new_state = env.step(state, action)
         done = new_state.done.astype(bool) if terminate_on_done else False
         return new_state, new_state.reward, done
 
-    return EnvSpec(  # pragma: no cover
+    return EnvSpec(
         reset=reset,
         obs=obs,
         step=step,

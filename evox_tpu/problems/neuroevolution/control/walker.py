@@ -107,14 +107,34 @@ def chain_walker(
 
     base_pos = _init_pos()
 
-    def _forces(pos: jax.Array, vel: jax.Array, action: jax.Array):
-        """Total force on each mass + per-mass contact normal force."""
+    def _ground(pos: jax.Array, vel: jax.Array) -> jax.Array:
+        """Per-mass contact normal force — action-independent, so the
+        observation path computes ONLY this instead of a full force pass
+        (the rod/torque math it would discard is the expensive part:
+        sqrt + divides on the VPU are multi-cycle ops)."""
+        depth = jnp.maximum(-pos[:, 1], 0.0)
+        contact = depth > 0.0
+        f_n = ground_stiffness * depth - ground_damping * vel[:, 1] * contact
+        return jnp.maximum(f_n, 0.0) * contact
+
+    def _forces(pos: jax.Array, vel: jax.Array, scaled_act: jax.Array):
+        """Total force on each mass (the obs path reads contact forces
+        through :func:`_ground` directly and no longer depends on this).
+
+        ``scaled_act`` is ``tanh(action) * torque_scale``, hoisted by the
+        caller: it is substep-invariant, and tanh is one of the few
+        multi-cycle transcendentals in the hot loop. The rod direction
+        divides go through one reciprocal-sqrt (``inv = rsqrt(d·d)``)
+        instead of sqrt + three divides — same math, ~4x fewer slow VPU
+        ops in the rod block."""
         f = jnp.zeros_like(pos).at[:, 1].add(-gravity)
 
         # rod springs: keep consecutive masses at rod_length
         d = pos[1:] - pos[:-1]  # (n_links, 2)
-        dist = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
-        u = d / dist[:, None]
+        dd = jnp.sum(d * d, axis=-1) + 1e-12
+        inv = jax.lax.rsqrt(dd)
+        dist = dd * inv  # == sqrt(dd)
+        u = d * inv[:, None]
         rel_v = jnp.sum((vel[1:] - vel[:-1]) * u, axis=-1)
         mag = rod_stiffness * (dist - rod_length) + rod_damping * rel_v
         f_rod = mag[:, None] * u  # pulls endpoints together when stretched
@@ -122,24 +142,20 @@ def chain_walker(
 
         # joint torques: actuator j applies equal-and-opposite tangential
         # forces to the masses flanking interior joint j+1
-        act = jnp.tanh(action) * torque_scale
         perp = jnp.stack([-u[:, 1], u[:, 0]], axis=-1)  # (n_links, 2)
-        tq = jnp.zeros(n_links).at[:act_dim].set(act)
-        f_tq = (tq / jnp.maximum(dist, 1e-6))[:, None] * perp
+        tq = jnp.zeros(n_links).at[:act_dim].set(scaled_act)
+        f_tq = (tq * jnp.minimum(inv, 1e6))[:, None] * perp
         f = f.at[:-1].add(f_tq).at[1:].add(-f_tq)
 
         # ground contact: spring-damper normal force + Coulomb-ish friction
-        depth = jnp.maximum(-pos[:, 1], 0.0)
-        contact = depth > 0.0
-        f_n = ground_stiffness * depth - ground_damping * vel[:, 1] * contact
-        f_n = jnp.maximum(f_n, 0.0) * contact
+        f_n = _ground(pos, vel)
         f_t = -jnp.clip(
             friction * f_n * jnp.sign(vel[:, 0]),
             -jnp.abs(vel[:, 0]) * 50.0,
             jnp.abs(vel[:, 0]) * 50.0,
         )
         f = f.at[:, 1].add(f_n).at[:, 0].add(f_t)
-        return f, f_n
+        return f
 
     def reset(key: jax.Array):
         k1, k2 = jax.random.split(key)
@@ -152,13 +168,15 @@ def chain_walker(
         root = pos[0]
         rel = pos - root  # root-relative positions
         d = pos[1:] - pos[:-1]
-        dist = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-12)
-        strain = dist / rod_length - 1.0
-        ang_cos = d[:, 0] / dist
-        ang_sin = d[:, 1] / dist
+        dd = jnp.sum(d * d, axis=-1) + 1e-12
+        inv = jax.lax.rsqrt(dd)  # one rsqrt replaces sqrt + three divides
+        dist = dd * inv
+        strain = dist * (1.0 / rod_length) - 1.0
+        ang_cos = d[:, 0] * inv
+        ang_sin = d[:, 1] * inv
         rel_v = vel[1:] - vel[:-1]
-        ang_vel = (d[:, 0] * rel_v[:, 1] - d[:, 1] * rel_v[:, 0]) / (dist * dist)
-        _, f_n = _forces(pos, vel, prev_a)
+        ang_vel = (d[:, 0] * rel_v[:, 1] - d[:, 1] * rel_v[:, 0]) * (inv * inv)
+        f_n = _ground(pos, vel)  # action-independent part of _forces
         parts = jnp.concatenate(
             [
                 rel.reshape(-1),  # 2n
@@ -179,16 +197,18 @@ def chain_walker(
 
     def step(state, action: jax.Array):
         pos, vel, _, t = state
+        tanh_a = jnp.tanh(action)  # substep-invariant: hoisted out of loop
+        scaled_act = tanh_a * torque_scale
 
         def substep(_, pv):
             p, v = pv
-            f, _ = _forces(p, v, action)
+            f = _forces(p, v, scaled_act)
             v = v + h * f  # unit masses; semi-implicit Euler
             return p + h * v, v
 
         pos, vel = jax.lax.fori_loop(0, substeps, substep, (pos, vel))
         com_vx = jnp.mean(vel[:, 0])
-        ctrl_cost = 0.01 * jnp.sum(jnp.tanh(action) ** 2)
+        ctrl_cost = 0.01 * jnp.sum(tanh_a**2)
         head_y = pos[-1, 1]
         fell = head_y < stand_height
         exploded = jnp.any(~jnp.isfinite(pos)) | (jnp.max(jnp.abs(pos)) > 1e3)

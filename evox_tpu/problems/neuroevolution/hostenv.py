@@ -91,18 +91,64 @@ class NumpyCartPoleVec:
         )
 
 
-def envpool_make(env_name: str, num_envs: int, **env_options) -> HostVectorEnv:
-    """Construct a real EnvPool env (optional dependency)."""
+class EnvPoolAdapter:
+    """Adapt an EnvPool gymnasium-API batch env to :class:`HostVectorEnv`.
+
+    EnvPool's gymnasium interface returns ``(obs, info)`` from ``reset()``
+    and ``(obs, reward, terminated, truncated, info)`` from ``step()`` —
+    this strips the infos and exposes the 4-tuple contract
+    :class:`HostEnvProblem` consumes. EnvPool fixes its RNG seed at
+    construction (``envpool.make(..., seed=...)``), so the per-evaluation
+    ``seed`` argument only triggers a reset; pass ``seed`` through
+    ``env_options`` for reproducible streams.
+
+    ``action_transform`` maps the policy's raw ``(num_envs, act_dim)``
+    output to what the env expects — e.g. ``lambda a: a.argmax(-1)`` for
+    discrete action spaces (reference env_pool.py:41-78 hands policy
+    output straight to EnvPool, which only works for continuous spaces).
+    """
+
+    def __init__(self, env, num_envs: int, action_transform=None):
+        self._env = env
+        self._action_transform = action_transform
+        self.num_envs = num_envs
+        self.obs_dim = int(np.prod(env.observation_space.shape))
+
+    def reset(self, seed: int) -> np.ndarray:
+        obs, _info = self._env.reset()
+        return np.asarray(obs, dtype=np.float32).reshape(self.num_envs, -1)
+
+    def step(self, actions: np.ndarray):
+        if self._action_transform is not None:
+            actions = self._action_transform(actions)
+        obs, reward, terminated, truncated, _info = self._env.step(actions)
+        return (
+            np.asarray(obs, dtype=np.float32).reshape(self.num_envs, -1),
+            np.asarray(reward, dtype=np.float32),
+            np.asarray(terminated, dtype=bool),
+            np.asarray(truncated, dtype=bool),
+        )
+
+
+def envpool_make(
+    env_name: str,
+    num_envs: int,
+    action_transform: Optional[Callable] = None,
+    **env_options,
+) -> HostVectorEnv:
+    """Construct a real EnvPool env (optional dependency), adapted to the
+    :class:`HostVectorEnv` protocol."""
     try:
-        import envpool  # pragma: no cover - optional dependency
-    except ImportError as e:  # pragma: no cover
+        import envpool
+    except ImportError as e:
         raise ImportError(
             "envpool is not installed; use NumpyCartPoleVec or another "
             "HostVectorEnv implementation"
         ) from e
-    return envpool.make(  # pragma: no cover
+    env = envpool.make(
         env_name, num_envs=num_envs, env_type="gymnasium", **env_options
     )
+    return EnvPoolAdapter(env, num_envs, action_transform)
 
 
 class HostEnvProblem(Problem):

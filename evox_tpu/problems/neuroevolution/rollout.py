@@ -190,6 +190,11 @@ class PolicyRolloutProblem(Problem):
             policies where per-step weight re-reads dominate
             (PERF_NOTES §9).
         fused_planes_tile: individuals per grid cell (multiple of 128).
+        fused_planes_dtype: VMEM residency dtype for the policy planes in
+            the big-policy kernel (e.g. ``jnp.bfloat16`` — halves the
+            kernel's VMEM-bandwidth roofline and doubles the per-tile
+            policy budget; accumulation and env math stay f32). None
+            keeps f32 residency.
     """
 
     def __init__(
@@ -209,6 +214,7 @@ class PolicyRolloutProblem(Problem):
         fused_interpret: Optional[bool] = None,
         fused_planes: Optional["PlaneEnv"] = None,
         fused_planes_tile: int = 128,
+        fused_planes_dtype: Any = None,
     ):
         self.policy = policy
         self.env = env
@@ -243,6 +249,7 @@ class PolicyRolloutProblem(Problem):
         self.fused_interpret = fused_interpret
         self.fused_planes = fused_planes
         self.fused_planes_tile = fused_planes_tile
+        self.fused_planes_dtype = fused_planes_dtype
         self._fused_policy_checked = False
 
     def _check_fused_base(self, base, name: str) -> None:
@@ -418,6 +425,7 @@ class PolicyRolloutProblem(Problem):
             episodes=ep,
             early_stop=self.fused_planes.terminating,
             interpret=interpret,
+            weight_dtype=self.fused_planes_dtype,
         )
         fitness = self.reduce_fn(totals.reshape(ep, pop_size).T, axis=-1)
         return fitness, RolloutState(key=key, cap=state.cap, norm=state.norm)
