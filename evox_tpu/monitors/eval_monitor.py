@@ -189,13 +189,20 @@ class EvalMonitor(Monitor):
                 self.solution_history.append(sol)
             return jnp.zeros((), dtype=jnp.int32)
 
+        # ordered=True threads a token whose replicated sharding the SPMD
+        # partitioner rejects on multi-process meshes ("side-effect HLO
+        # cannot have a replicated sharding"); drop the ordering token
+        # there — the callback still fires exactly once per generation on
+        # process 0 (asserted in tests/test_multiprocess_distributed.py),
+        # but cross-generation append order follows dispatch order rather
+        # than a token chain.
         io_callback(
             append,
             jax.ShapeDtypeStruct((), jnp.int32),
             fitness,
             cand,
             sharding=host0_sharding(),
-            ordered=True,
+            ordered=jax.process_count() == 1,
         )
 
     def _update_so(self, mstate, cand, fitness):

@@ -1,0 +1,249 @@
+"""Multi-process / multi-machine host rollout farm.
+
+Closes the one capability the reference's Ray stack had that a single
+process cannot give: scaling *non-jittable* CPU rollouts across worker
+PROCESSES and machines (reference workflows/distributed.py:224-380
+Supervisor/Worker actors + gym.py:59-264 Controller/Worker farm). The
+TPU-native replacement for jittable problems is the mesh (workflows/
+std.py); this module is for host simulators only.
+
+Design — a deliberately small TCP fan-out instead of an actor framework:
+
+- The :class:`ProcessRolloutFarm` coordinator listens on a socket.
+  Workers connect (same machine via :func:`spawn_local_workers`, or any
+  reachable machine via ``python -m evox_tpu.problems.neuroevolution.
+  process_farm HOST:PORT``), receive the pickled ``(env_creator, policy,
+  mo_keys)`` setup once, then serve per-generation rollout requests.
+- Each generation the coordinator splits the population across workers
+  (same ``_tree_split`` slices and ``seed + 7919 * i`` per-slice seeds as
+  the in-process :class:`HostRolloutFarm` with ``batch_policy=False`` —
+  fitness is reproducibly identical between the two farms, asserted in
+  tests/test_process_farm.py).
+- Workers run the reference's ``batch_policy=False`` placement: each
+  owns its env slice and loops episodes to completion with a local
+  jitted policy on its own host — the right mode across machines, where
+  per-step observation round-trips would serialize on network latency.
+- Messages are length-prefixed pickles. ``env_creator`` and ``policy``
+  must be picklable (module-level callables / functools.partial — the
+  same constraint Ray puts on its remote functions).
+
+Limits (documented contract, kept deliberately simple):
+- Fixed membership: workers must all be connected before the first
+  ``evaluate``; late joiners and worker deaths are errors, not rebalanced
+  (no fault tolerance — the reference's Ray path restarts actors; here a
+  failed generation surfaces as an exception and the caller re-creates
+  the farm).
+- The driver process stays the single owner of algorithm state; only
+  (subpop, seed, cap) requests and (rewards, mo, lengths) results cross
+  the wire.
+- Like every host problem, this is non-jittable: run it through the
+  workflow's callback path, ideally under
+  :func:`~evox_tpu.workflows.pipelined.run_host_pipelined` to overlap
+  device work with the farm round-trip.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.problem import Problem
+from .rollout_farm import _Worker, _tree_batch_size, _tree_split
+
+_LEN = struct.Struct(">Q")
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("farm peer closed the connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# ------------------------------------------------------------------ worker
+def worker_main(address: Tuple[str, int]) -> None:
+    """Connect to a coordinator and serve rollout requests until shutdown.
+
+    Run on any machine that can reach the coordinator:
+    ``python -m evox_tpu.problems.neuroevolution.process_farm HOST:PORT``.
+    """
+    sock = socket.create_connection(address)
+    try:
+        _send(sock, {"type": "register"})
+        setup = _recv(sock)
+        assert setup["type"] == "setup", setup
+        worker = _Worker(setup["env_creator"], setup["mo_keys"])
+        policy = jax.jit(jax.vmap(setup["policy"]))
+        while True:
+            msg = _recv(sock)
+            if msg["type"] == "shutdown":
+                return
+            assert msg["type"] == "rollout", msg
+            worker.rollout(policy, msg["subpop"], msg["seed"], msg["cap"])
+            rewards, mo, lengths = worker.results()
+            _send(
+                sock,
+                {"type": "result", "rewards": rewards, "mo": mo, "lengths": lengths},
+            )
+    finally:
+        sock.close()
+
+
+def spawn_local_workers(address: Tuple[str, int], n: int) -> list:
+    """Start ``n`` local worker processes connecting to ``address``.
+
+    Returns the ``multiprocessing.Process`` handles (daemonized; join or
+    let ``ProcessRolloutFarm.shutdown`` end them). Spawn start-method so
+    workers never inherit an initialized JAX backend."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(target=worker_main, args=(address,), daemon=True)
+        for _ in range(n)
+    ]
+    for p in procs:
+        p.start()
+    return procs
+
+
+# ------------------------------------------------------------- coordinator
+class ProcessRolloutFarm(Problem):
+    """Coordinator problem: shard host rollouts over worker processes.
+
+    Args:
+        policy: jittable ``(params, obs) -> action`` for ONE individual —
+            pickled to the workers, vmapped+jitted there.
+        env_creator: picklable zero-arg callable building one env.
+        num_workers: worker connections to wait for in :meth:`bind`.
+        mo_keys: env-info keys accumulated as objectives (reference
+            gym.py:83-94).
+        cap_episode: per-generation step cap handed to the workers.
+        port: coordinator port (0 = ephemeral; read ``self.address``).
+    """
+
+    jittable = False
+
+    def __init__(
+        self,
+        policy: Callable,
+        env_creator: Callable,
+        num_workers: int = 2,
+        mo_keys: Sequence[str] = (),
+        cap_episode: Optional[int] = None,
+        port: int = 0,
+        host: str = "0.0.0.0",
+    ):
+        self.policy = policy
+        self.env_creator = env_creator
+        self.num_workers = num_workers
+        self.mo_keys = tuple(mo_keys)
+        self.cap = cap_episode
+        self._server = socket.create_server((host, port))
+        self.address = ("127.0.0.1", self._server.getsockname()[1])
+        self._conns: list = []
+        self._seed_rng = np.random.default_rng()
+
+    # -- membership ---------------------------------------------------------
+    def bind(self, timeout: float = 60.0) -> None:
+        """Accept exactly ``num_workers`` connections and push the setup.
+        Call after the workers were started (``spawn_local_workers`` or
+        remote ``worker_main`` invocations)."""
+        self._server.settimeout(timeout)
+        while len(self._conns) < self.num_workers:
+            conn, _ = self._server.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            reg = _recv(conn)
+            assert reg["type"] == "register", reg
+            _send(
+                conn,
+                {
+                    "type": "setup",
+                    "env_creator": self.env_creator,
+                    "policy": self.policy,
+                    "mo_keys": self.mo_keys,
+                },
+            )
+            self._conns.append(conn)
+
+    def shutdown(self) -> None:
+        for conn in self._conns:
+            try:
+                _send(conn, {"type": "shutdown"})
+                conn.close()
+            except OSError:
+                pass
+        self._conns = []
+        self._server.close()
+
+    # -- Problem interface --------------------------------------------------
+    def fit_shape(self, pop_size: int) -> Tuple[int, ...]:
+        if self.mo_keys:
+            return (pop_size, len(self.mo_keys))
+        return (pop_size,)
+
+    def init(self, key=None):
+        return key if key is not None else jax.random.PRNGKey(0)
+
+    def evaluate(self, state, pop):
+        if not self._conns:
+            raise RuntimeError(
+                "no workers bound; call farm.bind() after starting workers"
+            )
+        seed = int(self._seed_rng.integers(0, np.iinfo(np.int32).max))
+        pop_size = _tree_batch_size(pop)
+        n_active = min(len(self._conns), pop_size)
+        conns = self._conns[:n_active]
+        subpops = _tree_split(pop, n_active)
+        # same per-slice seed law as HostRolloutFarm(batch_policy=False):
+        # the two farms produce identical fitness for identical seeds
+        for i, (conn, sp) in enumerate(zip(conns, subpops)):
+            _send(
+                conn,
+                {
+                    "type": "rollout",
+                    "subpop": jax.tree.map(np.asarray, sp),
+                    "seed": seed + 7919 * i,
+                    "cap": self.cap,
+                },
+            )
+        rewards, mo = [], []
+        for conn in conns:
+            res = _recv(conn)
+            assert res["type"] == "result", res
+            rewards.append(res["rewards"])
+            mo.append(res["mo"])
+        if self.mo_keys:
+            return jnp.asarray(np.concatenate(mo), dtype=jnp.float32), state
+        return jnp.asarray(np.concatenate(rewards), dtype=jnp.float32), state
+
+
+def _cli() -> None:  # pragma: no cover - exercised on remote machines
+    import sys
+
+    host, port = sys.argv[1].rsplit(":", 1)
+    worker_main((host, int(port)))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    _cli()

@@ -141,6 +141,23 @@ class StdWorkflow:
             raise ValueError(
                 "eval_shard_map requires a mesh and a jittable problem"
             )
+        if self.external and mesh is not None and jax.process_count() > 1:
+            # explicit refusal, not silent corruption: under a mesh that
+            # spans processes, the pure_callback would run problem.evaluate
+            # on EVERY process against its own population shard and an
+            # unsynchronized host-side problem object (reference's Ray path
+            # existed precisely to own this; SURVEY §7 "host callbacks").
+            # A mesh-less workflow stays legal multi-controller JAX: each
+            # process owns its whole population locally.
+            raise ValueError(
+                "external (host) problems are single-process: under "
+                "multi-process SPMD each process would invoke the host "
+                "evaluate on its own shard against unsynchronized host "
+                "state. Scale host rollouts across machines with "
+                "ProcessRolloutFarm (problems/neuroevolution/"
+                "process_farm.py), or use a jittable problem for mesh "
+                "parallelism."
+            )
         if mesh is not None:
             n_shards = mesh.shape[_POP_AXIS_NAME]
             pop_size = getattr(algorithm, "pop_size", None)

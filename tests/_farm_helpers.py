@@ -1,0 +1,43 @@
+"""Module-level (hence picklable) env/policy helpers for the
+multi-process rollout farm tests: worker processes unpickle these by
+qualified name, the same importability constraint Ray puts on remote
+functions."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from evox_tpu.problems.neuroevolution.hostenv import NumpyCartPoleVec
+
+
+class ScalarCartPole:
+    """Single-episode gymnasium-API wrapper over the numpy dynamics."""
+
+    def __init__(self):
+        self.vec = NumpyCartPoleVec(num_envs=1, max_steps=120)
+
+    def reset(self, seed=0):
+        return self.vec.reset(seed)[0], {}
+
+    def step(self, action):
+        obs, r, term, trunc = self.vec.step(np.asarray(action)[None])
+        return obs[0], float(r[0]), bool(term[0]), bool(trunc[0]), {"aux": 1.0}
+
+
+D_IN, D_H, D_OUT = 4, 8, 2
+DIM = D_IN * D_H + D_H + D_H * D_OUT + D_OUT
+
+
+def flat_policy(params, obs):
+    """Deterministic flat-genome MLP 4 -> 8 -> 2 (picklable by name)."""
+    i = 0
+    w1 = params[i : i + D_IN * D_H].reshape(D_IN, D_H)
+    i += D_IN * D_H
+    b1 = params[i : i + D_H]
+    i += D_H
+    w2 = params[i : i + D_H * D_OUT].reshape(D_H, D_OUT)
+    i += D_H * D_OUT
+    b2 = params[i : i + D_OUT]
+    h = jnp.tanh(obs @ w1 + b1)
+    return h @ w2 + b2

@@ -71,6 +71,10 @@ def test_brax_env_terminate_on_done_false(monkeypatch):
     assert done is False  # constant: XLA eliminates the branch
 
 
+@pytest.mark.skipif(
+    __import__("importlib.util", fromlist=["util"]).find_spec("brax") is not None,
+    reason="real brax installed",
+)
 def test_brax_env_missing_dep_message():
     with pytest.raises(ImportError, match="brax is not installed"):
         from evox_tpu.problems.neuroevolution.control.brax_adapter import brax_env
@@ -116,6 +120,10 @@ def test_envpool_make_matches_numpy_cartpole_golden(monkeypatch):
     assert float(np.max(np.asarray(f_pool))) > 1.0  # episodes actually ran
 
 
+@pytest.mark.skipif(
+    __import__("importlib.util", fromlist=["util"]).find_spec("envpool") is not None,
+    reason="real envpool installed",
+)
 def test_envpool_missing_dep_message():
     from evox_tpu.problems.neuroevolution.hostenv import envpool_make
 

@@ -1,0 +1,89 @@
+"""Multi-process rollout farm (VERDICT r3 task 6): a 2-worker-PROCESS
+farm must reproduce the single-process farm's fitness exactly, and drive
+through the workflow + run_host_pipelined like any host problem."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu.problems.neuroevolution.process_farm import (
+    ProcessRolloutFarm,
+    spawn_local_workers,
+)
+from evox_tpu.problems.neuroevolution.rollout_farm import HostRolloutFarm
+
+from tests._farm_helpers import DIM, ScalarCartPole, flat_policy
+
+
+@pytest.fixture
+def farm():
+    farm = ProcessRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=2, cap_episode=60,
+        host="127.0.0.1",
+    )
+    procs = spawn_local_workers(farm.address, 2)
+    try:
+        farm.bind(timeout=120.0)
+        yield farm
+    finally:
+        farm.shutdown()
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
+
+
+def test_process_farm_matches_single_process(farm):
+    """Same slices, same per-slice seed law -> identical fitness to the
+    in-process HostRolloutFarm(batch_policy=False)."""
+    pop = 0.5 * jax.random.normal(jax.random.PRNGKey(0), (10, DIM))
+
+    local = HostRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=2, batch_policy=False,
+        cap_episode=60,
+    )
+    # pin both farms' per-generation seed draws to the same stream
+    farm._seed_rng = np.random.default_rng(123)
+    local._seed_rng = np.random.default_rng(123)
+
+    f_proc, _ = farm.evaluate(farm.init(), pop)
+    f_local, _ = local.evaluate(local.init(), pop)
+    assert f_proc.shape == (10,)
+    np.testing.assert_allclose(
+        np.asarray(f_proc), np.asarray(f_local), rtol=1e-6, atol=1e-6
+    )
+    assert float(np.max(np.asarray(f_proc))) >= 1.0  # episodes ran
+
+    # a second generation reuses the persistent workers
+    f2, _ = farm.evaluate(farm.init(), pop)
+    assert f2.shape == (10,)
+
+
+def test_process_farm_through_pipelined_workflow(farm):
+    """The farm is a normal host problem: StdWorkflow + the overlapped
+    run_host_pipelined driver work unchanged on top of worker processes."""
+    from evox_tpu import StdWorkflow
+    from evox_tpu.algorithms.so.es import OpenES
+    from evox_tpu.workflows.pipelined import run_host_pipelined
+
+    algo = OpenES(jnp.zeros(DIM), pop_size=10, learning_rate=0.1, noise_stdev=0.5)
+    wf = StdWorkflow(algo, farm, opt_direction="max")
+    state = wf.init(jax.random.PRNGKey(1))
+    seen = []
+    state = run_host_pipelined(
+        wf, state, 3, on_generation=lambda g, s, f: seen.append(float(jnp.max(f)))
+    )
+    assert len(seen) == 3
+    assert all(v >= 1.0 for v in seen)
+
+
+def test_process_farm_unbound_raises():
+    farm = ProcessRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=1, host="127.0.0.1"
+    )
+    try:
+        with pytest.raises(RuntimeError, match="no workers bound"):
+            farm.evaluate(farm.init(), jnp.zeros((2, DIM)))
+    finally:
+        farm.shutdown()
