@@ -13,8 +13,11 @@ full mechanics:
 - mating selection is a tournament on the population's HypE fitness
   (ref ask:112-122);
 - m == 2 uses an EXACT leave-one-out hypervolume contribution (sorted
-  sweep — O(n log n), no sampling noise); m >= 3 uses the Monte-Carlo
-  alpha-weighted estimator (ref cal_hv:20-52).
+  sweep — O(n log n), no sampling noise); m == 3 also dispatches EXACT
+  (per-front leave-one-out via the m=3 sweep hypervolume,
+  metrics/hypervolume.py::hypervolume_3d — the reference is MC-only
+  above m=2) up to ``exact_hv_max_n`` rows; larger populations and
+  m >= 4 use the Monte-Carlo alpha-weighted estimator (ref cal_hv:20-52).
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...metrics.hypervolume import hypervolume_3d
 from ...operators.selection.basic import tournament_multifit
 from ...operators.selection.non_dominate import non_dominated_sort
 from .common import GAMOAlgorithm, MOState, uniform_init
@@ -48,6 +52,34 @@ def hype_fitness(
     )
     w = jnp.where(count > 0, alpha[jnp.clip(count - 1, 0, n - 1)], 0.0)  # (s,)
     return jnp.sum(dominated * w[:, None], axis=0)
+
+
+def exact_contrib_3d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Array:
+    """Exact leave-one-out hypervolume contribution for m = 3, computed
+    WITHIN each non-domination front (same per-front convention as
+    :func:`exact_contrib_2d`, so dominated points keep selection pressure
+    toward their own front instead of collapsing to 0).
+
+    ``contrib_i = HV3(front(i)) - HV3(front(i) \\ {i})`` via the masked
+    m=3 sweep hypervolume — 2n masked evaluations of O(n² log n) each
+    (O(n³ log n) compute, static shapes). The outer loop is ``lax.map``,
+    NOT vmap: batching would materialize (n, n, n) intermediates (~0.5 GB
+    at n=512) for an (n,)-float result; mapping caps residency at the
+    single evaluation's O(n²) (PERF_NOTES §13's rule). Sized for
+    selection populations; HypE gates it behind ``exact_hv_max_n``."""
+    n = fit.shape[0]
+    idx = jnp.arange(n)
+
+    def one(i):
+        front = rank == rank[i]
+        with_i = hypervolume_3d(fit, ref, mask=front)
+        without = hypervolume_3d(fit, ref, mask=front & (idx != i))
+        # clamp: contributions are non-negative by definition; cancellation
+        # between the two large sums can round an exact 0 to ~±1e-8, which
+        # would let rounding noise order the selection tie-break
+        return jnp.maximum(with_i - without, 0.0)
+
+    return jax.lax.map(one, idx)
 
 
 def exact_contrib_2d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Array:
@@ -81,9 +113,21 @@ class HypEState(MOState):
 
 
 class HypE(GAMOAlgorithm):
-    def __init__(self, lb, ub, n_objs, pop_size, n_samples: int = 8192, mesh=None):
+    def __init__(
+        self,
+        lb,
+        ub,
+        n_objs,
+        pop_size,
+        n_samples: int = 8192,
+        mesh=None,
+        exact_hv_max_n: int = 512,
+    ):
         super().__init__(lb, ub, n_objs, pop_size, mesh=mesh)
         self.n_samples = n_samples
+        # m=3 exact contributions are O(n^3 log n): dispatch exact up to
+        # this many (merged) rows, Monte-Carlo beyond. 0 forces MC.
+        self.exact_hv_max_n = exact_hv_max_n
 
     def init(self, key: jax.Array) -> HypEState:
         key, k = jax.random.split(key)
@@ -108,6 +152,8 @@ class HypE(GAMOAlgorithm):
     def _score(self, key, fit, ref, rank, k):
         if self.n_objs == 2:
             return exact_contrib_2d(fit, ref, rank)
+        if self.n_objs == 3 and fit.shape[0] <= self.exact_hv_max_n:
+            return exact_contrib_3d(fit, ref, rank)
         return hype_fitness(key, fit, ref, k, self.n_samples)
 
     def mate(self, key: jax.Array, state: HypEState) -> jax.Array:

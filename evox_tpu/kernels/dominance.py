@@ -102,21 +102,59 @@ def pack_dominator_rows(dom: jax.Array, n_words: int) -> jax.Array:
     )
 
 
+# Above this population size the dense (n, n) bool intermediate of the
+# one-shot build becomes the memory wall (n=100k -> 10 GB); the chunked
+# build below caps it at (chunk_rows, n).
+_DENSE_BUILD_MAX_N = 20_000
+_BUILD_CHUNK_ROWS = 4096
+
+
 def packed_dominance_reference(
-    fitness: jax.Array, n_words: Optional[int] = None
+    fitness: jax.Array,
+    n_words: Optional[int] = None,
+    chunk_rows: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pure-XLA fallback with identical outputs.
 
-    Builds the dense matrix with ``dominate_relation`` (whose lane-oriented
+    Builds the matrix with ``dominate_relation`` (whose lane-oriented
     objective loop is the same layout the kernel uses), then packs via the
-    reshape-multiply-reduce path.
+    reshape-multiply-reduce path. Beyond ``_DENSE_BUILD_MAX_N`` rows (or
+    with an explicit ``chunk_rows``) the build runs as a ``lax.map`` over
+    dominator-row slabs so the boolean intermediate never exceeds
+    ``(chunk_rows, n)`` — the packed (n²/8-byte) matrix itself is the only
+    O(n²) resident, which is what makes NSGA-II at pop=50k (merged
+    n=100k: packed ~1.25 GB vs a ~10 GB dense bool) fit on one chip.
+    ``+inf`` padding rows dominate nothing, so slab padding only appends
+    zero words (same argument as the mesh-sharded build).
     """
-    n = fitness.shape[0]
+    n, m = fitness.shape
     if n_words is None:
         n_words = (n + 31) // 32
-    dom = dominate_relation(fitness, fitness)
-    packed = pack_dominator_rows(dom, n_words)
-    count = jnp.sum(dom, axis=0, dtype=jnp.int32)
+    if chunk_rows is None:
+        chunk_rows = n if n <= _DENSE_BUILD_MAX_N else _BUILD_CHUNK_ROWS
+    if chunk_rows % 32 != 0:
+        chunk_rows = ((chunk_rows + 31) // 32) * 32
+    if chunk_rows >= n:
+        dom = dominate_relation(fitness, fitness)
+        packed = pack_dominator_rows(dom, n_words)
+        count = jnp.sum(dom, axis=0, dtype=jnp.int32)
+        return packed, count
+
+    n_chunks = -(-n // chunk_rows)
+    rows_pad = n_chunks * chunk_rows
+    fit_rows = jnp.pad(
+        fitness, ((0, rows_pad - n), (0, 0)), constant_values=jnp.inf
+    )
+    slabs = fit_rows.reshape(n_chunks, chunk_rows, m)
+
+    def one(slab):
+        return pack_dominator_rows(
+            dominate_relation(slab, fitness), chunk_rows // 32
+        )
+
+    packed = jax.lax.map(one, slabs).reshape(n_chunks * (chunk_rows // 32), n)
+    packed = packed[:n_words]
+    count = jnp.sum(jax.lax.population_count(packed), axis=0, dtype=jnp.int32)
     return packed, count
 
 

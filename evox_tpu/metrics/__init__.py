@@ -1,6 +1,12 @@
 from .igd import igd, igd_plus, IGD, IGDPlus
 from .gd import gd, gd_plus, GD, GDPlus
-from .hypervolume import hypervolume_2d, hypervolume_mc, HV
+from .hypervolume import (
+    HV,
+    hypervolume_2d,
+    hypervolume_3d,
+    hypervolume_contributions,
+    hypervolume_mc,
+)
 
 __all__ = [
     "igd",
@@ -13,5 +19,7 @@ __all__ = [
     "GDPlus",
     "hypervolume_mc",
     "hypervolume_2d",
+    "hypervolume_3d",
+    "hypervolume_contributions",
     "HV",
 ]

@@ -1,36 +1,107 @@
 """Hypervolume indicators: Monte-Carlo (reference
 src/evox/metrics/hypervolume.py:7-96, with the same two sampling
-strategies: one bounding cube, or one cube per solution) plus an exact
-2-objective variant the reference lacks — for m=2 the exact sweep is one
-sort, so there is no reason to tolerate MC noise."""
+strategies: one bounding cube, or one cube per solution) plus exact
+2- and 3-objective variants the reference lacks — at m=2 the exact sweep
+is one sort and at m=3 one sweep of 2-D staircases, so there is no
+reason to tolerate MC noise at those arities."""
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 
-def hypervolume_2d(objs: jax.Array, ref: jax.Array) -> jax.Array:
+def _staircase_area(f1: jax.Array, f2: jax.Array, ref2: jax.Array) -> jax.Array:
+    """Area dominated by the points ``(f1_i, f2_i)`` inside the box below
+    ``ref2`` (minimization): one sort, prefix-min staircase, slab sum."""
+    order = jnp.argsort(f1)
+    f1s = f1[order]
+    f2s = f2[order]
+    f2_min = jax.lax.associative_scan(jnp.minimum, f2s)
+    right = jnp.concatenate([f1s[1:], ref2[:1]])  # slab right edges
+    widths = jnp.maximum(right - f1s, 0.0)
+    heights = jnp.maximum(ref2[1] - f2_min, 0.0)
+    return jnp.sum(widths * heights)
+
+
+def hypervolume_2d(
+    objs: jax.Array, ref: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
     """Exact hypervolume for 2 objectives (minimization).
 
     Sort by the first objective and sum the rectangular slabs between the
     staircase of non-dominated prefix minima and the reference point —
     O(n log n), deterministic, jit-safe. Points outside the reference box
     contribute nothing; dominated points are absorbed by the running
-    minimum.
+    minimum. ``mask``: rows set False are excluded (moved onto ``ref``,
+    where their rectangle is empty).
     """
     n, m = objs.shape
     if m != 2:
         raise ValueError(f"hypervolume_2d needs 2 objectives, got {m}")
-    order = jnp.argsort(objs[:, 0])
-    f1 = jnp.minimum(objs[order, 0], ref[0])
-    f2 = jnp.minimum(objs[order, 1], ref[1])
-    # staircase: the best (lowest) f2 seen so far dominates this slab
-    f2_min = jax.lax.associative_scan(jnp.minimum, f2)
-    right = jnp.concatenate([f1[1:], ref[:1]])  # slab right edges
-    widths = jnp.maximum(right - f1, 0.0)
-    heights = jnp.maximum(ref[1] - f2_min, 0.0)
-    return jnp.sum(widths * heights)
+    pts = jnp.minimum(objs, ref)
+    if mask is not None:
+        pts = jnp.where(mask[:, None], pts, ref)
+    return _staircase_area(pts[:, 0], pts[:, 1], ref)
+
+
+def hypervolume_3d(
+    objs: jax.Array, ref: jax.Array, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """EXACT hypervolume for 3 objectives (minimization) — beyond the
+    reference, whose only option above m=2 is Monte-Carlo.
+
+    Sweep over the third objective: sorted by ``f3``, the volume is the
+    sum over levels ``i`` of ``(z_{i+1} - z_i) * A_i`` where ``A_i`` is
+    the 2-D staircase area of the first ``i+1`` points' ``(f1, f2)``
+    rectangles. Every prefix area is an independent O(n log n) staircase,
+    vmapped — O(n² log n) total with static shapes, fully jit-safe.
+    ``mask``: rows set False are excluded.
+    """
+    n, m = objs.shape
+    if m != 3:
+        raise ValueError(f"hypervolume_3d needs 3 objectives, got {m}")
+    pts = jnp.minimum(objs, ref)
+    if mask is not None:
+        pts = jnp.where(mask[:, None], pts, ref)
+    order = jnp.argsort(pts[:, 2])
+    p = pts[order]
+    z = p[:, 2]
+    z_next = jnp.concatenate([z[1:], ref[2:3]])
+    thick = jnp.maximum(z_next - z, 0.0)
+    idx = jnp.arange(n)
+
+    def prefix_area(i):
+        live = idx <= i
+        f1 = jnp.where(live, p[:, 0], ref[0])
+        f2 = jnp.where(live, p[:, 1], ref[1])
+        return _staircase_area(f1, f2, ref[:2])
+
+    areas = jax.vmap(prefix_area)(idx)
+    return jnp.sum(areas * thick)
+
+
+def hypervolume_contributions(objs: jax.Array, ref: jax.Array) -> jax.Array:
+    """Exact leave-one-out hypervolume contributions (m = 2 or 3):
+    ``contrib_i = HV(S) - HV(S \\ {i})``. Dominated and out-of-box points
+    get exactly 0. O(n² log n) at m=2, O(n³ log n) at m=3 (n masked
+    re-evaluations) — sized for selection/archive populations, not
+    million-point clouds."""
+    n, m = objs.shape
+    hv = {2: hypervolume_2d, 3: hypervolume_3d}.get(m)
+    if hv is None:
+        raise ValueError(f"exact contributions need m in (2, 3), got {m}")
+    total = hv(objs, ref)
+    idx = jnp.arange(n)
+    # lax.map, not vmap: batching the m=3 evaluation would materialize
+    # (n, n, n) intermediates for an (n,)-float result
+    without = jax.lax.map(lambda i: hv(objs, ref, mask=idx != i), idx)
+    # clamp: contributions are non-negative by definition; the subtraction
+    # of two large near-equal sums can round a dominated point's exact 0
+    # to ~-1e-8
+    return jnp.maximum(total - without, 0.0)
 
 
 def hypervolume_mc(
@@ -69,7 +140,8 @@ def hypervolume_mc(
 
 
 class HV:
-    """Hypervolume indicator: exact for 2 objectives, Monte-Carlo beyond."""
+    """Hypervolume indicator: exact for 2 and 3 objectives, Monte-Carlo
+    beyond (the reference is MC-only above m=2)."""
 
     def __init__(self, ref: jax.Array, num_samples: int = 100_000,
                  sample_method: str = "bounding_cube"):
@@ -80,4 +152,6 @@ class HV:
     def __call__(self, key: jax.Array, objs: jax.Array) -> jax.Array:
         if self.ref.shape[0] == 2:
             return hypervolume_2d(objs, self.ref)  # exact; key unused
+        if self.ref.shape[0] == 3:
+            return hypervolume_3d(objs, self.ref)  # exact; key unused
         return hypervolume_mc(key, objs, self.ref, self.num_samples, self.sample_method)
