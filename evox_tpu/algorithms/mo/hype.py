@@ -25,7 +25,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...metrics.hypervolume import hypervolume_3d
+from ...metrics.hypervolume import hypervolume_contributions
 from ...operators.selection.basic import tournament_multifit
 from ...operators.selection.non_dominate import non_dominated_sort
 from .common import GAMOAlgorithm, MOState, uniform_init
@@ -57,29 +57,12 @@ def hype_fitness(
 def exact_contrib_3d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Array:
     """Exact leave-one-out hypervolume contribution for m = 3, computed
     WITHIN each non-domination front (same per-front convention as
-    :func:`exact_contrib_2d`, so dominated points keep selection pressure
-    toward their own front instead of collapsing to 0).
-
-    ``contrib_i = HV3(front(i)) - HV3(front(i) \\ {i})`` via the masked
-    m=3 sweep hypervolume — 2n masked evaluations of O(n² log n) each
-    (O(n³ log n) compute, static shapes). The outer loop is ``lax.map``,
-    NOT vmap: batching would materialize (n, n, n) intermediates (~0.5 GB
-    at n=512) for an (n,)-float result; mapping caps residency at the
-    single evaluation's O(n²) (PERF_NOTES §13's rule). Sized for
-    selection populations; HypE gates it behind ``exact_hv_max_n``."""
-    n = fit.shape[0]
-    idx = jnp.arange(n)
-
-    def one(i):
-        front = rank == rank[i]
-        with_i = hypervolume_3d(fit, ref, mask=front)
-        without = hypervolume_3d(fit, ref, mask=front & (idx != i))
-        # clamp: contributions are non-negative by definition; cancellation
-        # between the two large sums can round an exact 0 to ~±1e-8, which
-        # would let rounding noise order the selection tie-break
-        return jnp.maximum(with_i - without, 0.0)
-
-    return jax.lax.map(one, idx)
+    :func:`exact_contrib_2d`) — one shared implementation:
+    :func:`~evox_tpu.metrics.hypervolume.hypervolume_contributions` with
+    the ranks as the grouping (O(n³ log n), lax.map residency, clamped
+    non-negative — rationale documented there). Sized for selection
+    populations; HypE gates it behind ``exact_hv_max_n``."""
+    return hypervolume_contributions(fit, ref, group=rank)
 
 
 def exact_contrib_2d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Array:

@@ -7,11 +7,15 @@ in-repo: this module meta-trains the LES attention/learning-rate
 networks by meta-black-box optimization — an outer OpenES over the
 ~200 network parameters, whose meta-fitness is LES's own optimization
 performance over a task distribution (shifted/rotated sphere,
-ill-conditioned ellipsoid, rastrigin, rosenbrock) — the same recipe as
-the paper, at a smaller scale. The resulting parameters are bundled at
-``data/les_params.npz`` and loaded by ``LES(params="auto")`` (the
-default); ``python -m evox_tpu.algorithms.so.es.les_meta`` regenerates
-them.
+ill-conditioned ellipsoid, multimodal rastrigin, rosenbrock, and a
+teacher–student MLP regression loss — a real non-benchmark landscape)
+— the same recipe as the paper, at a smaller scale. The resulting
+parameters are bundled at ``data/les_params.npz`` and loaded by
+``LES(params="auto")`` (the default);
+``python -m evox_tpu.algorithms.so.es.les_meta`` regenerates them.
+Transfer is asserted on HELD-OUT families never seen in training
+(Ackley, Griewank — tests/test_so_es.py) as well as held-out quadratics
+at a transfer dimension.
 
 Both LES networks are shape-agnostic (the attention net is pop-wise,
 the lr net dimension-wise), so parameters trained at dim=8/pop=16
@@ -39,22 +43,44 @@ PARAMS_PATH = Path(__file__).parent / "data" / "les_params.npz"
 META_DIM = 8
 INNER_POP = 16
 INNER_GENS = 40
-TASKS_PER_GEN = 8
+TASKS_PER_GEN = 10
+N_FAMILIES = 5
 OUTER_POP = 64
-OUTER_GENS = 1500
+OUTER_GENS = 4000
 OUTER_LR = 0.03
 OUTER_STD = 0.05
 
 
+# fixed probe inputs for the teacher–student MLP family (a constant of
+# the task family, like rastrigin's cosine frequency)
+_MLP_INPUTS = jnp.linspace(-1.0, 1.0, 16)
+
+
+def _tiny_mlp_forward(p: jax.Array, u: jax.Array) -> jax.Array:
+    """1-2-1 tanh net from the first 7 entries of ``p``: ``(..., 7+)``
+    params, ``(k,)`` inputs -> ``(..., k)`` outputs."""
+    w1 = p[..., 0:2]
+    b1 = p[..., 2:4]
+    w2 = p[..., 4:6]
+    b2 = p[..., 6]
+    h = jnp.tanh(u[:, None] * w1[..., None, :] + b1[..., None, :])
+    return jnp.sum(h * w2[..., None, :], axis=-1) + b2[..., None]
+
+
 def sample_task(key: jax.Array, dim: int) -> Dict[str, jax.Array]:
-    """One random task: family index + shift + rotation + conditioning."""
-    kt, ks, kr, ka = jax.random.split(key, 4)
+    """One random task: family index + shift + rotation + conditioning +
+    (for the MLP family) a random teacher's probe outputs."""
+    kt, ks, kr, ka, km = jax.random.split(key, 5)
     rot, _ = jnp.linalg.qr(jax.random.normal(kr, (dim, dim)))
+    teacher = _tiny_mlp_forward(
+        1.5 * jax.random.normal(km, (7,)), _MLP_INPUTS
+    )
     return {
-        "type": jax.random.randint(kt, (), 0, 4),
+        "type": jax.random.randint(kt, (), 0, 5),
         "shift": jax.random.uniform(ks, (dim,), minval=-2.0, maxval=2.0),
         "rot": rot,
         "alphas": 10.0 ** jax.random.uniform(ka, (dim,), minval=0.0, maxval=3.0),
+        "teacher": teacher,
     }
 
 
@@ -83,8 +109,14 @@ def task_eval(task: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
             axis=-1,
         )
 
+    def mlp_loss(y):
+        # teacher–student regression: y's first 7 entries parameterize the
+        # student; optimum 0 at the (rotated/shifted image of the) teacher
+        out = _tiny_mlp_forward(y, _MLP_INPUTS)
+        return jnp.mean((out - task["teacher"]) ** 2, axis=-1)
+
     return jax.lax.switch(
-        task["type"], [sphere, ellipsoid, rastrigin, rosenbrock], y
+        task["type"], [sphere, ellipsoid, rastrigin, rosenbrock, mlp_loss], y
     )
 
 
@@ -137,10 +169,16 @@ def meta_train(
     @jax.jit
     def meta_step(ostate, key):
         k_task, k_run = jax.random.split(key)
-        # common random numbers: every candidate sees the same tasks/seeds
+        # common random numbers: every candidate sees the same tasks/seeds.
+        # STRATIFIED families (task i gets family i mod N): per-family
+        # loss scales differ by orders of magnitude, so a uniform draw
+        # makes the meta-objective jump between generations — balanced
+        # coverage keeps the outer gradient estimate comparable across
+        # generations
         tasks = jax.vmap(lambda k: sample_task(k, META_DIM))(
             jax.random.split(k_task, TASKS_PER_GEN)
         )
+        tasks["type"] = jnp.arange(TASKS_PER_GEN, dtype=jnp.int32) % N_FAMILIES
         run_keys = jax.random.split(k_run, TASKS_PER_GEN)
         cand, ostate = outer.ask(ostate)
         fit = jax.vmap(lambda c: meta_objective(c, tasks, run_keys))(cand)

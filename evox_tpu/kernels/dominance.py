@@ -153,7 +153,11 @@ def packed_dominance_reference(
         )
 
     packed = jax.lax.map(one, slabs).reshape(n_chunks * (chunk_rows // 32), n)
-    packed = packed[:n_words]
+    built = packed.shape[0]
+    if built >= n_words:
+        packed = packed[:n_words]
+    else:  # caller requested extra word budget: zero-pad like the dense path
+        packed = jnp.pad(packed, ((0, n_words - built), (0, 0)))
     count = jnp.sum(jax.lax.population_count(packed), axis=0, dtype=jnp.int32)
     return packed, count
 

@@ -83,25 +83,40 @@ def hypervolume_3d(
     return jnp.sum(areas * thick)
 
 
-def hypervolume_contributions(objs: jax.Array, ref: jax.Array) -> jax.Array:
+def hypervolume_contributions(
+    objs: jax.Array, ref: jax.Array, group: Optional[jax.Array] = None
+) -> jax.Array:
     """Exact leave-one-out hypervolume contributions (m = 2 or 3):
     ``contrib_i = HV(S) - HV(S \\ {i})``. Dominated and out-of-box points
-    get exactly 0. O(n² log n) at m=2, O(n³ log n) at m=3 (n masked
-    re-evaluations) — sized for selection/archive populations, not
-    million-point clouds."""
+    get exactly 0. With ``group`` (an (n,) label array, e.g. Pareto
+    ranks), each point's contribution is computed WITHIN its own group —
+    HypE's per-front convention, where dominated points keep selection
+    pressure toward their front instead of collapsing to 0.
+    O(n² log n) at m=2, O(n³ log n) at m=3 (n masked re-evaluations) —
+    sized for selection/archive populations, not million-point clouds.
+    The outer loop is ``lax.map``, not vmap: batching the m=3 evaluation
+    would materialize (n, n, n) intermediates for an (n,)-float result.
+    Results are clamped non-negative (contributions are by definition;
+    cancellation between two large near-equal sums can round an exact 0
+    to ~-1e-8, which would otherwise let rounding noise order
+    selection tie-breaks)."""
     n, m = objs.shape
     hv = {2: hypervolume_2d, 3: hypervolume_3d}.get(m)
     if hv is None:
         raise ValueError(f"exact contributions need m in (2, 3), got {m}")
-    total = hv(objs, ref)
     idx = jnp.arange(n)
-    # lax.map, not vmap: batching the m=3 evaluation would materialize
-    # (n, n, n) intermediates for an (n,)-float result
-    without = jax.lax.map(lambda i: hv(objs, ref, mask=idx != i), idx)
-    # clamp: contributions are non-negative by definition; the subtraction
-    # of two large near-equal sums can round a dominated point's exact 0
-    # to ~-1e-8
-    return jnp.maximum(total - without, 0.0)
+    if group is None:
+        total = hv(objs, ref)
+        without = jax.lax.map(lambda i: hv(objs, ref, mask=idx != i), idx)
+        return jnp.maximum(total - without, 0.0)
+
+    def one(i):
+        mine = group == group[i]
+        with_i = hv(objs, ref, mask=mine)
+        without = hv(objs, ref, mask=mine & (idx != i))
+        return jnp.maximum(with_i - without, 0.0)
+
+    return jax.lax.map(one, idx)
 
 
 def hypervolume_mc(

@@ -230,3 +230,66 @@ def test_les_meta_trained_beats_random_and_openes():
         scores["openes"] += float(run_on(openes, task, k, True)) / n_seeds
     assert scores["trained"] < scores["openes"] - 0.5, scores
     assert scores["trained"] < scores["random"] - 1.0, scores
+
+
+def test_les_meta_transfers_to_unseen_families():
+    """VERDICT r3 task 8: the bundled meta-trained LES must beat OpenES at
+    an equal budget on >=2 families NEVER seen in meta-training (training
+    draws sphere/ellipsoid/rastrigin/rosenbrock/MLP-loss; held-out here:
+    Ackley and Griewank), at a transfer dimension (12 vs training 8)."""
+    import functools
+    import math
+
+    from evox_tpu.algorithms.so.es import LES as LESAlgo
+    from evox_tpu.algorithms.so.es.les_meta import load_params, sample_task
+
+    params = load_params()
+    assert params is not None
+    dim, pop, gens, n_seeds = 12, 16, 50, 3
+
+    def ackley(task, x):
+        y = (x - task["shift"]) @ task["rot"].T
+        d = y.shape[-1]
+        return (
+            -20.0 * jnp.exp(-0.2 * jnp.sqrt(jnp.sum(y**2, -1) / d))
+            - jnp.exp(jnp.sum(jnp.cos(2 * math.pi * y), -1) / d)
+            + 20.0
+            + math.e
+        )
+
+    def griewank(task, x):
+        y = (x - task["shift"]) @ task["rot"].T
+        d = y.shape[-1]
+        i = jnp.sqrt(jnp.arange(1, d + 1, dtype=jnp.float32))
+        return (
+            jnp.sum(y**2, -1) / 4000.0
+            - jnp.prod(jnp.cos(y / i), -1)
+            + 1.0
+        )
+
+    @functools.partial(jax.jit, static_argnums=(0, 1, 3))
+    def run_on(algo, fam, task, shape):
+        state = algo.init(jax.random.PRNGKey(11))
+
+        def gen(state, _):
+            cand, state = algo.ask(state)
+            fit = fam(task, cand)
+            state = algo.tell(state, rank_based_fitness(fit) if shape else fit)
+            return state, jnp.min(fit)
+
+        _, bests = jax.lax.scan(gen, state, length=gens)
+        return jnp.log10(jnp.min(bests) + 1e-10)
+
+    wins = 0
+    for fam in (ackley, griewank):
+        trained = LESAlgo(jnp.zeros(dim), pop_size=pop, params=params)
+        openes = OpenES(jnp.zeros(dim), pop, learning_rate=0.05, noise_stdev=0.1)
+        t_score = o_score = 0.0
+        for seed in range(n_seeds):
+            task = sample_task(jax.random.PRNGKey(900 + seed), dim)
+            t_score += float(run_on(trained, fam, task, False)) / n_seeds
+            o_score += float(run_on(openes, fam, task, True)) / n_seeds
+        if t_score < o_score:
+            wins += 1
+        print(f"{fam.__name__}: trained {t_score:.2f} vs OpenES {o_score:.2f}")
+    assert wins >= 2, "meta-trained LES must beat OpenES on both unseen families"
