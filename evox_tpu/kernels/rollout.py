@@ -388,10 +388,12 @@ def fused_rollout(
             (tile x dim x 4 bytes, default 2048 x 81 ≈ 660 KB — the
             measured v5e optimum, PERF_NOTES §8).
         episodes: episodes per individual. The grid is 2-D
-            ``(episodes, n/tile)`` and the theta BlockSpec maps every
-            episode row to the same genome block, so multi-episode
-            evaluation re-reads theta from HBM instead of materializing a
-            ``jnp.repeat``-ed copy.
+            ``(n/tile, episodes)`` with episodes innermost: every episode
+            row maps to the same genome block, and because consecutive
+            grid steps then revisit an unchanged theta index, Pallas
+            elides the re-fetch — theta streams from HBM once per genome
+            block regardless of episode count (and no ``jnp.repeat``-ed
+            copy ever materializes).
 
     Returns:
         ``(episodes * n,)`` total rewards, episode-major.
@@ -465,15 +467,19 @@ def fused_rollout(
 
     total = pl.pallas_call(
         wrapped,
-        grid=(episodes, blocks),
+        # episodes INNERMOST: consecutive grid steps that differ only in
+        # the episode index keep the same theta block, so Pallas's
+        # revisiting pipeline elides the redundant HBM fetch — theta
+        # streams once per genome block instead of once per episode
+        grid=(blocks, episodes),
         in_specs=[
-            pl.BlockSpec((dim, rows_tile, _LANES), lambda e, b: (0, b, 0))
+            pl.BlockSpec((dim, rows_tile, _LANES), lambda b, e: (0, b, 0))
         ]
         + [
-            pl.BlockSpec((1, rows_tile, _LANES), lambda e, b: (e, b, 0))
+            pl.BlockSpec((1, rows_tile, _LANES), lambda b, e: (e, b, 0))
             for _ in state_keys
         ],
-        out_specs=pl.BlockSpec((1, rows_tile, _LANES), lambda e, b: (e, b, 0)),
+        out_specs=pl.BlockSpec((1, rows_tile, _LANES), lambda b, e: (e, b, 0)),
         out_shape=jax.ShapeDtypeStruct(
             (episodes, rows_pop, _LANES), theta.dtype
         ),

@@ -364,7 +364,7 @@ def _rollout_mlp_kernel(
         _, _, total = jax.lax.fori_loop(
             0, T, lambda _, c: body(*c), (state, done0, total0)
         )
-    out_ref[...] = total
+    out_ref[...] = total.reshape(out_ref.shape)
 
 
 @functools.partial(
@@ -462,16 +462,16 @@ def fused_mlp_rollout(
 
     w_specs = [
         pl.BlockSpec(
-            (w.shape[0], w.shape[1], tile), lambda e, b: (0, 0, b)
+            (w.shape[0], w.shape[1], tile), lambda b, e: (0, 0, b)
         )
         for w in weights
     ]
     b_specs = [
-        pl.BlockSpec((b.shape[0], tile), lambda e, b: (0, b)) for b in biases
+        pl.BlockSpec((b.shape[0], tile), lambda b, e: (0, b)) for b in biases
     ]
     s_specs = [
         pl.BlockSpec(
-            (1, state_3d[k].shape[1], tile), lambda e, b: (e, 0, b)
+            (1, state_3d[k].shape[1], tile), lambda b, e: (e, 0, b)
         )
         for k in state_keys
     ]
@@ -492,11 +492,20 @@ def fused_mlp_rollout(
     out_dtype = jnp.float32  # the documented reward-sum contract
     total = pl.pallas_call(
         wrapped,
-        grid=(episodes, blocks),
+        # episodes INNERMOST: consecutive grid steps differing only in the
+        # episode index revisit unchanged weight/bias blocks, so Pallas
+        # elides their re-fetch — the resident policy tile is DMA'd once
+        # per block regardless of episode count
+        grid=(blocks, episodes),
         in_specs=w_specs + b_specs + s_specs,
-        out_specs=pl.BlockSpec((1, tile), lambda e, b: (e, b)),
-        out_shape=jax.ShapeDtypeStruct((episodes, n_pad), out_dtype),
+        # 3-D output (episodes, 1, n_pad): Mosaic's lowering constrains
+        # only the LAST TWO block dims (divisible by (8, 128) or equal to
+        # the array dims); a 2-D (episodes, n_pad) array with block
+        # (1, tile) violates that whenever episodes > 1 — a latent
+        # multi-episode compile failure the CPU interpret tests never saw
+        out_specs=pl.BlockSpec((1, 1, tile), lambda b, e: (e, 0, b)),
+        out_shape=jax.ShapeDtypeStruct((episodes, 1, n_pad), out_dtype),
         interpret=interpret,
         **kwargs,
     )(*weights, *biases, *state_3d.values())
-    return total[:, :n].reshape(episodes * n)
+    return total[:, 0, :n].reshape(episodes * n)

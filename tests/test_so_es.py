@@ -178,6 +178,26 @@ def test_les_runs():
     assert run_algorithm(algo, 100) < run_algorithm(algo, 1) * 10
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 4, 5))
+def _les_benchmark_run(algo, eval_fn, task, key, gens, shape):
+    """Shared LES-benchmark harness: run ``algo`` for ``gens`` generations
+    on ``eval_fn(task, cand)`` and return the log10 best-gap (one budget/
+    scoring convention for every LES-vs-baseline comparison here)."""
+    state = algo.init(key)
+
+    def gen(state, _):
+        cand, state = algo.ask(state)
+        fit = eval_fn(task, cand)
+        state = algo.tell(state, rank_based_fitness(fit) if shape else fit)
+        return state, jnp.min(fit)
+
+    _, bests = jax.lax.scan(gen, state, length=gens)
+    return jnp.log10(jnp.min(bests) + 1e-10)
+
+
 def test_les_meta_trained_beats_random_and_openes():
     """The bundled meta-trained parameters (les_meta.py, the in-repo
     replacement for the reference's evosax pickle — reference
@@ -186,8 +206,6 @@ def test_les_meta_trained_beats_random_and_openes():
     training dim 8) it beats both the random-params LES and OpenES at an
     equal evaluation budget. Measured margins: trained ~-3.0 vs OpenES
     ~-1.1 vs random ~+1.5 mean log10-gap over 8 seeds."""
-    import functools
-
     from evox_tpu.algorithms.so.es.les_meta import (
         load_params,
         sample_task,
@@ -199,20 +217,10 @@ def test_les_meta_trained_beats_random_and_openes():
     assert params is not None, "bundled les_params.npz failed to load"
     dim, pop, gens = 12, 16, 50
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
     def run_on(algo, task, key, shape=False):
-        state = algo.init(key)
-
-        def gen(state, _):
-            cand, state = algo.ask(state)
-            fit = task_eval(task, cand)
-            state = algo.tell(
-                state, rank_based_fitness(fit) if shape else fit
-            )
-            return state, jnp.min(fit)
-
-        _, bests = jax.lax.scan(gen, state, length=gens)
-        return jnp.log10(jnp.min(bests) + 1e-10)
+        return _les_benchmark_run(
+            algo, lambda t, c: task_eval(t, c), task, key, gens, shape
+        )
 
     trained = LESAlgo(jnp.zeros(dim), pop_size=pop, params=params)
     untrained = LESAlgo(jnp.zeros(dim), pop_size=pop, params=None)
@@ -237,7 +245,6 @@ def test_les_meta_transfers_to_unseen_families():
     an equal budget on >=2 families NEVER seen in meta-training (training
     draws sphere/ellipsoid/rastrigin/rosenbrock/MLP-loss; held-out here:
     Ackley and Griewank), at a transfer dimension (12 vs training 8)."""
-    import functools
     import math
 
     from evox_tpu.algorithms.so.es import LES as LESAlgo
@@ -267,18 +274,10 @@ def test_les_meta_transfers_to_unseen_families():
             + 1.0
         )
 
-    @functools.partial(jax.jit, static_argnums=(0, 1, 3))
     def run_on(algo, fam, task, shape):
-        state = algo.init(jax.random.PRNGKey(11))
-
-        def gen(state, _):
-            cand, state = algo.ask(state)
-            fit = fam(task, cand)
-            state = algo.tell(state, rank_based_fitness(fit) if shape else fit)
-            return state, jnp.min(fit)
-
-        _, bests = jax.lax.scan(gen, state, length=gens)
-        return jnp.log10(jnp.min(bests) + 1e-10)
+        return _les_benchmark_run(
+            algo, fam, task, jax.random.PRNGKey(11), gens, shape
+        )
 
     wins = 0
     for fam in (ackley, griewank):
