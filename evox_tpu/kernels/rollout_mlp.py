@@ -269,10 +269,11 @@ def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes) -> jax.Array:
     full-width (fan_out, tile) FMAs (weights differ per lane -> no MXU).
 
     Weight planes may be bf16 (``fused_mlp_rollout(weight_dtype=...)``):
-    each slice is widened to f32 at load and the accumulator stays f32 —
-    the inner loop streams the weight planes from VMEM every env step, so
-    at humanoid scale the kernel is VMEM-bandwidth-bound and halving the
-    resident bytes is a direct speedup (measured: see PERF_NOTES §11)."""
+    each slice is widened to f32 at load and the accumulator stays f32.
+    Measured at walker scale this is throughput-NEUTRAL (the load-byte
+    saving is offset by the widening converts — PERF_NOTES §11); what
+    bf16 buys is a 2x per-tile policy budget and half the per-episode
+    HBM weight traffic."""
     h = obs
     n_layers = len(sizes) - 1
     for li in range(n_layers):

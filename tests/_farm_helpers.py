@@ -12,10 +12,12 @@ from evox_tpu.problems.neuroevolution.hostenv import NumpyCartPoleVec
 
 
 class ScalarCartPole:
-    """Single-episode gymnasium-API wrapper over the numpy dynamics."""
+    """Single-episode gymnasium-API wrapper over the numpy dynamics.
+    ``max_steps`` defaults to the farm tests' historical 200-step horizon
+    so tests can state their own."""
 
-    def __init__(self):
-        self.vec = NumpyCartPoleVec(num_envs=1, max_steps=120)
+    def __init__(self, max_steps: int = 200):
+        self.vec = NumpyCartPoleVec(num_envs=1, max_steps=max_steps)
 
     def reset(self, seed=0):
         return self.vec.reset(seed)[0], {}

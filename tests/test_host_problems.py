@@ -242,18 +242,8 @@ def test_native_vecenv_trains_cartpole(native):
 
 # ----------------------------------------------------------- rollout farm
 
-class _ScalarCartPole:
-    """Single-episode gymnasium-API wrapper over the numpy dynamics."""
-
-    def __init__(self):
-        self.vec = NumpyCartPoleVec(num_envs=1, max_steps=200)
-
-    def reset(self, seed=0):
-        return self.vec.reset(seed)[0], {}
-
-    def step(self, action):
-        obs, r, term, trunc = self.vec.step(np.asarray(action)[None])
-        return obs[0], float(r[0]), bool(term[0]), bool(trunc[0]), {"aux": 1.0}
+# one shared picklable definition (also used by the process-farm tests)
+from tests._farm_helpers import ScalarCartPole as _ScalarCartPole  # noqa: E402
 
 
 @pytest.mark.parametrize("batch_policy", [True, False])
