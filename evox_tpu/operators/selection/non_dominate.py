@@ -344,9 +344,14 @@ def rank_crowding_truncate(
     front). Returns ``(order, ranks)`` — survivor indices into ``fitness``
     and their ranks. Shared by NSGA-II's ``tell`` and the GA-skeleton
     MOEAs' migration ingest (one source of truth for the truncation).
-    ``mesh``: shard the O(n²) sort across its ``"pop"`` axis."""
-    rank = non_dominated_sort(fitness, until=k, mesh=mesh)
-    worst_rank = jnp.sort(rank)[k - 1]
+    ``mesh``: shard the O(n²) sort across its ``"pop"`` axis.
+
+    The worst admitted rank comes from the peel loop's free cut-rank
+    by-product (PERF_NOTES §4) — a ``jnp.sort(rank)[k-1]`` here would
+    re-pay the ~5 ms O(n log n) pass that optimization removed."""
+    rank, worst_rank = non_dominated_sort(
+        fitness, until=k, return_cut_rank=True, mesh=mesh
+    )
     crowd = crowding_distance(fitness, mask=rank == worst_rank)
     order = jnp.lexsort((-crowd, rank))[:k]
     return order, rank[order]

@@ -13,8 +13,11 @@ from evox_tpu.problems.neuroevolution.hostenv import NumpyCartPoleVec
 
 class ScalarCartPole:
     """Single-episode gymnasium-API wrapper over the numpy dynamics.
-    ``max_steps`` defaults to the farm tests' historical 200-step horizon
-    so tests can state their own."""
+
+    ``max_steps`` sets the truncation horizon (default 200, matching the
+    host-farm tests' original inline helper; the process-farm tests cap
+    episodes well below it either way — pass a different value when a
+    test needs its own horizon)."""
 
     def __init__(self, max_steps: int = 200):
         self.vec = NumpyCartPoleVec(num_envs=1, max_steps=max_steps)
