@@ -122,8 +122,19 @@ def test_ibea_dtlz2_igd():
 
 
 def test_hype_dtlz2_igd():
-    algo = HypE(LB, UB, n_objs=M, pop_size=100)
+    # MC scoring path (exact_hv_max_n=0): the r3-baseline convergence
+    # contract, CI-cheap. The exact m=3 path has its own convergence
+    # test below plus golden-value pinning in test_metrics.
+    algo = HypE(LB, UB, n_objs=M, pop_size=100, exact_hv_max_n=0)
     assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
+
+
+def test_hype_exact_m3_dtlz2_igd():
+    """Convergence with the EXACT m=3 per-front contributions (the
+    default dispatch at this scale): smaller pop/gens keep the O(n^3)
+    scoring CI-affordable while still asserting the IGD threshold."""
+    algo = HypE(LB, UB, n_objs=M, pop_size=48)
+    assert _igd_after(algo, DTLZ2(d=DIM, m=M), 60) < 0.35
 
 
 def test_knea_dtlz2_igd():
