@@ -22,6 +22,19 @@ update
 fitness the second row of the pair wins). The algorithm is
 HBM-streaming-bound; see PERF_NOTES §12 for the measured traffic budget
 and the shared-chip streaming roofline that caps this leg.
+
+State carries NO ask→tell intermediates: ``tell`` replays the pairing
+pass from the carried generation key (JAX's PRNG is counter-based, so
+the replay is bit-identical — the OpenES/PGPE trick of PERF_NOTES §10).
+Inside the fused jitted step XLA CSEs the replay against ``ask``'s pass
+(zero extra compute); what it buys is the loop carry — ~40 MB/gen of
+dead winners/candidates writes at the bench shape (pop=4096, d=1024)
+that a ``fori_loop`` of generations otherwise round-trips through HBM
+(PERF_NOTES §12, measured 1.1–1.25x on the streaming-bound leg). Under
+separately-jitted ask/tell (external problems) the replay costs one
+extra streaming pass — still cheaper than carrying it in HBM state.
+The state structure is branch-invariant, so ``lax.cond`` container
+dispatch (containers/clustered.py) needs no special-casing.
 """
 
 from __future__ import annotations
@@ -45,18 +58,11 @@ class CSOState(PyTreeNode):
     population: jax.Array = field(sharding=P(POP_AXIS))
     fitness: jax.Array = field(sharding=P(POP_AXIS))
     velocity: jax.Array = field(sharding=P(POP_AXIS))
-    # pair-major intermediates carried from ask to tell (half-pop leading
-    # axis). Inside a fused step they are XLA temporaries. (An empty-(0,d)
-    # post-tell form that would drop them from the loop carry was
-    # prototyped — ~1.1x on the streaming-bound bench leg — but rejected:
-    # wrappers that run ask under lax.cond (containers/clustered.py:169)
-    # need the state STRUCTURE identical on both branches.)
-    winners: jax.Array = field(sharding=P(POP_AXIS))
-    winner_velocity: jax.Array = field(sharding=P(POP_AXIS))
-    winner_fitness: jax.Array = field(sharding=P(POP_AXIS))
-    candidates: jax.Array = field(sharding=P(POP_AXIS))
-    candidate_velocity: jax.Array = field(sharding=P(POP_AXIS))
     key: jax.Array = field(sharding=P())
+    # the generation key ``ask`` drew — ``tell`` replays the pairing pass
+    # from it instead of carrying five half-pop intermediate arrays in the
+    # loop state (see module docstring)
+    pair_key: jax.Array = field(sharding=P())
 
 
 class CSO(Algorithm):
@@ -72,17 +78,12 @@ class CSO(Algorithm):
         k_state, k_pop = jax.random.split(key)
         span = self.ub - self.lb
         pop = jax.random.uniform(k_pop, (self.pop_size, self.dim)) * span + self.lb
-        half = self.pop_size // 2
         return CSOState(
             population=pop,
             fitness=jnp.full((self.pop_size,), jnp.inf),
             velocity=jnp.zeros((self.pop_size, self.dim)),
-            winners=jnp.zeros((half, self.dim)),
-            winner_velocity=jnp.zeros((half, self.dim)),
-            winner_fitness=jnp.full((half,), jnp.inf),
-            candidates=jnp.zeros((half, self.dim)),
-            candidate_velocity=jnp.zeros((half, self.dim)),
             key=k_state,
+            pair_key=k_state,  # placeholder; ask overwrites before any tell
         )
 
     # first generation: evaluate everyone once
@@ -92,8 +93,14 @@ class CSO(Algorithm):
     def init_tell(self, state: CSOState, fitness: jax.Array) -> CSOState:
         return state.replace(fitness=fitness)
 
-    def ask(self, state: CSOState) -> Tuple[jax.Array, CSOState]:
-        key, k_pair, k1, k2, k3 = jax.random.split(state.key, 5)
+    def _pair_pass(self, state: CSOState, k_gen: jax.Array):
+        """The whole pair-major generation pass, derived from ``k_gen``.
+
+        Called once in ``ask`` and replayed bit-identically in ``tell``
+        (same key, counter-based PRNG); inside the fused step XLA CSEs the
+        two calls into one. Returns (winner x/v/f, candidates, new_v).
+        """
+        k_pair, k1, k2, k3 = jax.random.split(k_gen, 4)
         half = self.pop_size // 2
         # the ONE gather: population/velocity/fitness into pair-major
         # layout (pair i = permuted rows i and half+i — the block-split
@@ -119,24 +126,23 @@ class CSO(Algorithm):
         r3 = jax.random.uniform(k3, (half, self.dim))
         new_v = r1 * v_s + r2 * (x_w - x_s) + self.phi * r3 * (center - x_s)
         candidates = jnp.clip(x_s + new_v, self.lb, self.ub)
-        return candidates, state.replace(
-            winners=x_w,
-            winner_velocity=v_w,
-            winner_fitness=f_w,
-            candidates=candidates,
-            candidate_velocity=new_v,
-            key=key,
-        )
+        return x_w, v_w, f_w, candidates, new_v
+
+    def ask(self, state: CSOState) -> Tuple[jax.Array, CSOState]:
+        key, k_gen = jax.random.split(state.key)
+        _, _, _, candidates, _ = self._pair_pass(state, k_gen)
+        return candidates, state.replace(key=key, pair_key=k_gen)
 
     def tell(self, state: CSOState, fitness: jax.Array) -> CSOState:
-        # streaming writes only: the next generation's row order is
-        # (winners ‖ updated losers) — a set-preserving relabeling, which
-        # the next ask's fresh uniform permutation makes distributionally
-        # identical to the reference's in-place scatter update
+        # replay ask's pass from the carried key (bit-identical; see
+        # _pair_pass), then streaming writes only: the next generation's
+        # row order is (winners ‖ updated losers) — a set-preserving
+        # relabeling, which the next ask's fresh uniform permutation makes
+        # distributionally identical to the reference's in-place scatter
+        # update
+        x_w, v_w, f_w, candidates, new_v = self._pair_pass(state, state.pair_key)
         return state.replace(
-            population=jnp.concatenate([state.winners, state.candidates]),
-            velocity=jnp.concatenate(
-                [state.winner_velocity, state.candidate_velocity]
-            ),
-            fitness=jnp.concatenate([state.winner_fitness, fitness]),
+            population=jnp.concatenate([x_w, candidates]),
+            velocity=jnp.concatenate([v_w, new_v]),
+            fitness=jnp.concatenate([f_w, fitness]),
         )
