@@ -113,8 +113,20 @@ class EnvPoolAdapter:
         self._action_transform = action_transform
         self.num_envs = num_envs
         self.obs_dim = int(np.prod(env.observation_space.shape))
+        self._warned_seed = False
 
     def reset(self, seed: int) -> np.ndarray:
+        if not self._warned_seed:
+            self._warned_seed = True
+            import warnings
+
+            warnings.warn(
+                "EnvPoolAdapter ignores the per-evaluation seed (EnvPool "
+                "fixes its RNG at construction): every generation replays "
+                "the same episode stream. Pass seed= through env_options "
+                "at envpool_make() for a chosen stream.",
+                stacklevel=2,
+            )
         obs, _info = self._env.reset()
         return np.asarray(obs, dtype=np.float32).reshape(self.num_envs, -1)
 
@@ -137,7 +149,11 @@ def envpool_make(
     **env_options,
 ) -> HostVectorEnv:
     """Construct a real EnvPool env (optional dependency), adapted to the
-    :class:`HostVectorEnv` protocol."""
+    :class:`HostVectorEnv` protocol.
+
+    Seeding: EnvPool fixes its RNG at construction, so per-evaluation
+    seeds are ignored (a one-time warning fires on first reset) — pass
+    ``seed=`` here via ``env_options`` to pick the episode stream."""
     try:
         import envpool
     except ImportError as e:

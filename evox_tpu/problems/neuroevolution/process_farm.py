@@ -27,6 +27,16 @@ Design — a deliberately small TCP fan-out instead of an actor framework:
   must be picklable (module-level callables / functools.partial — the
   same constraint Ray puts on its remote functions).
 
+Trust boundary: unpickling executes arbitrary code, so BOTH sides must
+trust the peer. The coordinator binds loopback by default and every
+connection completes a mutual HMAC challenge/response handshake (the
+``multiprocessing.connection`` scheme, raw bytes only — no pickle
+crosses the wire before both sides prove knowledge of ``authkey``).
+For multi-machine use bind an explicit interface, set a private
+``authkey`` on both sides, and treat the key as granting code
+execution on every participant: run the farm only on networks where
+every host that can reach the port is trusted.
+
 Limits (documented contract, kept deliberately simple):
 - Fixed membership: workers must all be connected before the first
   ``evaluate``; late joiners and worker deaths are errors, not rebalanced
@@ -44,6 +54,8 @@ Limits (documented contract, kept deliberately simple):
 
 from __future__ import annotations
 
+import hmac
+import os
 import pickle
 import socket
 import struct
@@ -58,16 +70,62 @@ from .rollout_farm import _Worker, _tree_batch_size, _tree_split
 
 _LEN = struct.Struct(">Q")
 
+# Default shared secret for same-machine farms (spawn_local_workers). It
+# gates accidental connections, not attackers — multi-machine deployments
+# MUST pass their own private authkey to both sides (see module docstring).
+DEFAULT_AUTHKEY = b"evox-tpu-farm"
 
-def _send(sock: socket.socket, obj: Any) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+def _send_bytes(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def _recv(sock: socket.socket) -> Any:
+def _recv_bytes(sock: socket.socket, limit: int = 1 << 16) -> bytes:
     header = _recv_exact(sock, _LEN.size)
     (n,) = _LEN.unpack(header)
-    return pickle.loads(_recv_exact(sock, n))
+    if n > limit:  # handshake frames are tiny; reject junk before reading it
+        raise ConnectionError("oversized handshake frame")
+    return _recv_exact(sock, n)
+
+
+def _deliver_challenge(sock: socket.socket, authkey: bytes) -> None:
+    """Prove the PEER knows ``authkey`` (multiprocessing.connection scheme)."""
+    challenge = os.urandom(32)
+    _send_bytes(sock, challenge)
+    digest = _recv_bytes(sock)
+    if not hmac.compare_digest(
+        digest, hmac.new(authkey, challenge, "sha256").digest()
+    ):
+        _send_bytes(sock, b"#FAIL")
+        raise ConnectionError("farm peer failed authkey challenge")
+    _send_bytes(sock, b"#OK")
+
+
+def _answer_challenge(sock: socket.socket, authkey: bytes) -> None:
+    """Prove to the peer that WE know ``authkey``."""
+    challenge = _recv_bytes(sock)
+    _send_bytes(sock, hmac.new(authkey, challenge, "sha256").digest())
+    if _recv_bytes(sock) != b"#OK":
+        raise ConnectionError("authkey rejected by farm peer")
+
+
+def _handshake(sock: socket.socket, authkey: bytes, server: bool) -> None:
+    """Mutual authentication — runs BEFORE any pickle crosses the wire, so
+    neither side unpickles bytes from an unauthenticated peer."""
+    if server:
+        _deliver_challenge(sock, authkey)
+        _answer_challenge(sock, authkey)
+    else:
+        _answer_challenge(sock, authkey)
+        _deliver_challenge(sock, authkey)
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    _send_bytes(sock, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _recv(sock: socket.socket) -> Any:
+    return pickle.loads(_recv_bytes(sock, limit=1 << 62))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -80,15 +138,39 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def _advertised_host(bind_host: str) -> str:
+    """The host remote workers should dial: the bind interface itself,
+    except for the IPv4 wildcard bind (the only wildcard ``create_server``
+    accepts under its default AF_INET family), where the
+    outbound-interface address is resolved via a connectionless UDP route
+    lookup."""
+    if bind_host not in ("0.0.0.0", ""):
+        return bind_host
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect(("203.0.113.1", 9))  # TEST-NET-3: no packet is sent
+        return probe.getsockname()[0]
+    except OSError:  # no route (isolated host): loopback is all there is
+        return "127.0.0.1"
+    finally:
+        probe.close()
+
+
 # ------------------------------------------------------------------ worker
-def worker_main(address: Tuple[str, int]) -> None:
+def worker_main(
+    address: Tuple[str, int], authkey: bytes = DEFAULT_AUTHKEY
+) -> None:
     """Connect to a coordinator and serve rollout requests until shutdown.
 
     Run on any machine that can reach the coordinator:
-    ``python -m evox_tpu.problems.neuroevolution.process_farm HOST:PORT``.
+    ``python -m evox_tpu.problems.neuroevolution.process_farm HOST:PORT``
+    (set ``EVOX_TPU_FARM_AUTHKEY`` to the coordinator's authkey). The
+    connection is mutually authenticated before any pickle is exchanged —
+    see the module docstring for the trust boundary.
     """
     sock = socket.create_connection(address)
     try:
+        _handshake(sock, authkey, server=False)
         _send(sock, {"type": "register"})
         setup = _recv(sock)
         assert setup["type"] == "setup", setup
@@ -109,7 +191,9 @@ def worker_main(address: Tuple[str, int]) -> None:
         sock.close()
 
 
-def spawn_local_workers(address: Tuple[str, int], n: int) -> list:
+def spawn_local_workers(
+    address: Tuple[str, int], n: int, authkey: bytes = DEFAULT_AUTHKEY
+) -> list:
     """Start ``n`` local worker processes connecting to ``address``.
 
     Returns the ``multiprocessing.Process`` handles (daemonized; join or
@@ -119,7 +203,7 @@ def spawn_local_workers(address: Tuple[str, int], n: int) -> list:
 
     ctx = mp.get_context("spawn")
     procs = [
-        ctx.Process(target=worker_main, args=(address,), daemon=True)
+        ctx.Process(target=worker_main, args=(address, authkey), daemon=True)
         for _ in range(n)
     ]
     for p in procs:
@@ -140,6 +224,11 @@ class ProcessRolloutFarm(Problem):
             gym.py:83-94).
         cap_episode: per-generation step cap handed to the workers.
         port: coordinator port (0 = ephemeral; read ``self.address``).
+        host: bind interface. Defaults to loopback; for multi-machine
+            farms bind an explicit interface (or ``"0.0.0.0"``) AND set a
+            private ``authkey`` — see the module docstring trust boundary.
+        authkey: shared secret for the mutual HMAC handshake every
+            connection must pass before any pickle is exchanged.
     """
 
     jittable = False
@@ -152,15 +241,22 @@ class ProcessRolloutFarm(Problem):
         mo_keys: Sequence[str] = (),
         cap_episode: Optional[int] = None,
         port: int = 0,
-        host: str = "0.0.0.0",
+        host: str = "127.0.0.1",
+        authkey: bytes = DEFAULT_AUTHKEY,
     ):
         self.policy = policy
         self.env_creator = env_creator
         self.num_workers = num_workers
         self.mo_keys = tuple(mo_keys)
         self.cap = cap_episode
+        self.authkey = authkey
         self._server = socket.create_server((host, port))
-        self.address = ("127.0.0.1", self._server.getsockname()[1])
+        # advertise an address remote workers can actually use: the bind
+        # host, except for wildcard binds where we resolve this machine's
+        # outbound interface (loopback would be wrong off-machine)
+        self.address = (
+            _advertised_host(host), self._server.getsockname()[1]
+        )
         self._conns: list = []
         self._seed_rng = np.random.default_rng()
 
@@ -173,6 +269,16 @@ class ProcessRolloutFarm(Problem):
         while len(self._conns) < self.num_workers:
             conn, _ = self._server.accept()
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # bound the whole handshake+register exchange: a silent peer
+            # (port scanner holding the connection open) must not hang
+            # bind() — it gets dropped and we keep listening
+            conn.settimeout(timeout)
+            try:
+                _handshake(conn, self.authkey, server=True)
+            except (ConnectionError, OSError):
+                conn.close()  # unauthenticated/silent peer: drop, keep going
+                continue
+            conn.settimeout(None)  # rollout requests may legitimately be slow
             reg = _recv(conn)
             assert reg["type"] == "register", reg
             _send(
@@ -242,7 +348,11 @@ def _cli() -> None:  # pragma: no cover - exercised on remote machines
     import sys
 
     host, port = sys.argv[1].rsplit(":", 1)
-    worker_main((host, int(port)))
+    authkey = os.environ.get("EVOX_TPU_FARM_AUTHKEY", "")
+    worker_main(
+        (host, int(port)),
+        authkey.encode() if authkey else DEFAULT_AUTHKEY,
+    )
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -87,3 +87,25 @@ def test_process_farm_unbound_raises():
             farm.evaluate(farm.init(), jnp.zeros((2, DIM)))
     finally:
         farm.shutdown()
+
+
+def test_process_farm_rejects_wrong_authkey():
+    """A peer that fails the HMAC handshake is dropped before any pickle
+    is read from it; a correct-key worker connecting next still binds."""
+    farm = ProcessRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=1, cap_episode=30,
+        host="127.0.0.1", authkey=b"right-key",
+    )
+    bad = spawn_local_workers(farm.address, 1, authkey=b"wrong-key")
+    good = spawn_local_workers(farm.address, 1, authkey=b"right-key")
+    try:
+        farm.bind(timeout=120.0)
+        assert len(farm._conns) == 1
+        fit, _ = farm.evaluate(farm.init(), jnp.zeros((4, DIM)))
+        assert fit.shape == (4,)
+    finally:
+        farm.shutdown()
+        for p in bad + good:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.kill()
