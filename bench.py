@@ -29,17 +29,30 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 WARMUP = 3
-REPEATS = 3
-# Each workload's two sides are measured in interleaved rounds
-# (ours, ref, ours, ref) with the compiled functions kept alive, and each
-# side takes its best round — the tunneled chip's throughput drifts by tens
-# of percent over minutes, so back-to-back phases would skew the ratio.
-# 4 rounds: with 2, whole-run ratio spread across repeated identical-code
-# bench runs measured ±25% (chip phase luck); best-of-4 lets both sides
-# reach a good phase, tightening the ratio estimate.
-INTERLEAVE_ROUNDS = 4
+REPEATS = 2
+# Interleaved measurement rounds per leg (ours, ref, ours, ref, ...): the
+# official ratio is the MEDIAN of per-round ratios and the min/max spread
+# is recorded in the JSON so a single driver capture is self-qualifying.
+INTERLEAVE_ROUNDS = 5
+
+# Every dispatch through the tunneled chip pays a 45-100 ms round-trip
+# whose magnitude DRIFTS with tunnel load — at r4's trip counts that
+# latency was most of the measured time and all of the run-to-run ratio
+# noise (per-leg swings of ±25% across identical-code runs). Each timing
+# therefore runs TWO trip counts and reports the differenced slope
+#     t_gen = (t(n2) - t(n1)) / (n2 - n1)
+# which cancels the per-call latency exactly while keeping every
+# per-generation cost (the reference's per-step dispatch included — that
+# recurring cost is its design, not tunnel noise). The host fetch that
+# ends a timing is a small fixed-size array for both sides (constant,
+# cancelled too). Validated against jitted probe loops: the slope
+# reproduces within ±6% across runs where the old protocol swung ±25%,
+# and the same harness measures HBM triad at 607 GB/s and bf16 matmul at
+# ~206 TF/s on this chip — the spec-sheet roofline, not the "48 GB/s"
+# the latency-confounded r3/r4 probes reported.
 
 
 def _patch_reference_imports() -> None:
@@ -59,44 +72,67 @@ def _patch_reference_imports() -> None:
         _shd.PositionalSharding = _PositionalSharding
 
 
-def _loop_measurer(step, state, n):
-    """Warm up a Python step loop; return a () -> secs/gen measurer."""
-    state = jax.block_until_ready(step(state))  # ensure compiled+warm
+def _fetch(tree) -> None:
+    """Force execution with a real host fetch of one small leaf —
+    block_until_ready alone can return before the tunneled compute ran."""
+    leaves = [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+    np.asarray(leaves[0])
+
+
+def _differenced(timed, n1: int, n2: int):
+    """() -> secs/gen from the t(n2)-t(n1) slope; latency cancels.
+    Returns NaN when noise inverts the pair (caller drops the round)."""
 
     def measure():
-        best = float("inf")
-        for _ in range(REPEATS):
-            t0 = time.perf_counter()
-            s = state
-            for _ in range(n):
-                s = step(s)
-            jax.block_until_ready(s)
-            best = min(best, (time.perf_counter() - t0) / n)
-        return best
+        t1 = min(timed(n1) for _ in range(REPEATS))
+        t2 = min(timed(n2) for _ in range(REPEATS))
+        dt = (t2 - t1) / (n2 - n1)
+        return dt if dt > 0 else float("nan")
 
     return measure
 
 
-def _run_measurer(wf, state, n):
-    """Warm up evox_tpu's fused run(); return a () -> secs/gen measurer."""
+def _loop_measurer(step, state, n_pair):
+    """Reference side: a Python loop of per-step dispatches (its real
+    recurring cost), one fixed-size fetch at the end."""
+    state = step(state)
+    _fetch(state)  # compiled + warm
+
+    def timed(n):
+        t0 = time.perf_counter()
+        s = state
+        for _ in range(n):
+            s = step(s)
+        _fetch(s)
+        return time.perf_counter() - t0
+
+    return _differenced(timed, *n_pair)
+
+
+def _run_measurer(wf, state, n_pair):
+    """Our side: one fused run() dispatch per timing, both trip counts
+    pre-compiled, one fixed-size fetch at the end."""
     for _ in range(WARMUP):
         state = wf.step(state)
-    jax.block_until_ready(wf.run(state, n))
 
-    def measure():
-        best = float("inf")
-        for _ in range(REPEATS):
-            t0 = time.perf_counter()
-            jax.block_until_ready(wf.run(state, n))
-            best = min(best, (time.perf_counter() - t0) / n)
-        return best
+    def timed(n):
+        t0 = time.perf_counter()
+        s = wf.run(state, n)
+        _fetch(s)
+        return time.perf_counter() - t0
 
-    return measure
+    for n in n_pair:
+        timed(n)  # compile both trip counts before timing
+
+    return _differenced(timed, *n_pair)
 
 
 # ------------------------------------------------------------------ workload 1
 
-CSO_POP, CSO_DIM, CSO_STEPS = 4096, 1024, 100
+CSO_POP, CSO_DIM = 4096, 1024
+# trip-count pairs sized so the differenced segment is >=0.3 s of chip
+# time per side (slope noise ±few %), per-timing wall stays ~1 s
+CSO_PAIR_OURS, CSO_PAIR_REF = (100, 1100), (100, 600)
 
 
 def bench_cso_ours():
@@ -107,7 +143,7 @@ def bench_cso_ours():
     algo = CSO(lb=-32.0 * jnp.ones(CSO_DIM), ub=32.0 * jnp.ones(CSO_DIM), pop_size=CSO_POP)
     wf = StdWorkflow(algo, Ackley())
     state = wf.init(jax.random.PRNGKey(42))
-    return _run_measurer(wf, state, CSO_STEPS), CSO_POP
+    return _run_measurer(wf, state, CSO_PAIR_OURS), CSO_POP
 
 
 def bench_cso_ref():
@@ -118,7 +154,7 @@ def bench_cso_ref():
     state = wf.init(jax.random.PRNGKey(42))
     for _ in range(WARMUP):
         state = wf.step(state)
-    return _loop_measurer(wf.step, state, CSO_STEPS), CSO_POP
+    return _loop_measurer(wf.step, state, CSO_PAIR_REF), CSO_POP
 
 
 # ------------------------------------------------------------------ workload 2
@@ -130,7 +166,8 @@ def bench_cso_ref():
 # tests/test_kernels.py); the reference runs its own engine shape — the
 # double-vmap ``lax.while_loop`` of reference brax.py:62-97.
 
-RO_POP, RO_STEPS, RO_EPISODES = 65536, 10, 2
+RO_POP, RO_EPISODES = 65536, 2
+RO_PAIR_OURS, RO_PAIR_REF = (5, 45), (5, 25)
 RO_HIDDEN = 16
 
 
@@ -163,7 +200,7 @@ def bench_rollout_ours():
     algo = OpenES(jnp.zeros(dim), RO_POP, learning_rate=0.05, noise_stdev=0.05)
     wf = StdWorkflow(algo, prob, opt_direction="max")
     state = wf.init(jax.random.PRNGKey(0))
-    return _run_measurer(wf, state, RO_STEPS), RO_POP
+    return _run_measurer(wf, state, RO_PAIR_OURS), RO_POP
 
 
 def bench_rollout_ref():
@@ -187,7 +224,7 @@ def bench_rollout_ref():
     state = wf.init(jax.random.PRNGKey(0))
     for _ in range(WARMUP):
         state = wf.step(state)
-    return _loop_measurer(wf.step, state, RO_STEPS), RO_POP
+    return _loop_measurer(wf.step, state, RO_PAIR_REF), RO_POP
 
 
 # ----------------------------------------------------------------- workload 2b
@@ -203,7 +240,8 @@ def bench_rollout_ref():
 # resident in VMEM across the episode — measured ~6x the scan engine,
 # PERF_NOTES §9), the reference its double-vmap while_loop engine shape.
 
-W_POP, W_STEPS, W_HIDDEN, W_MAXLEN = 16384, 3, 64, 100
+W_POP, W_HIDDEN, W_MAXLEN = 16384, 64, 100
+W_PAIR_OURS, W_PAIR_REF = (2, 12), (1, 4)
 
 
 def _walker_problem(fused: bool = False):
@@ -242,7 +280,7 @@ def _bench_walker_ours(pop: int):
         fit_transforms=(rank_based_fitness,),
     )
     state = wf.init(jax.random.PRNGKey(0))
-    return _run_measurer(wf, state, W_STEPS), pop
+    return _run_measurer(wf, state, W_PAIR_OURS), pop
 
 
 def bench_walker_ours():
@@ -289,12 +327,13 @@ def bench_walker_ref():
     state = wf.init(jax.random.PRNGKey(0))
     for _ in range(WARMUP):
         state = wf.step(state)
-    return _loop_measurer(wf.step, state, W_STEPS), W_POP
+    return _loop_measurer(wf.step, state, W_PAIR_REF), W_POP
 
 
 # ------------------------------------------------------------------ workload 3
 
-MO_POP, MO_DIM, MO_M, MO_STEPS = 10000, 300, 3, 10
+MO_POP, MO_DIM, MO_M = 10000, 300, 3
+MO_PAIR_OURS, MO_PAIR_REF = (5, 45), (3, 17)
 
 
 def bench_nsga2_ours():
@@ -307,7 +346,7 @@ def bench_nsga2_ours():
     algo = NSGA2(lb=lb, ub=ub, n_objs=MO_M, pop_size=MO_POP)
     wf = StdWorkflow(algo, prob)
     state = wf.init(jax.random.PRNGKey(1))
-    return _run_measurer(wf, state, MO_STEPS), 1.0
+    return _run_measurer(wf, state, MO_PAIR_OURS), 1.0
 
 
 def bench_nsga2_ref():
@@ -321,7 +360,55 @@ def bench_nsga2_ref():
     state = wf.init(jax.random.PRNGKey(1))
     for _ in range(WARMUP):
         state = wf.step(state)
-    return _loop_measurer(wf.step, state, MO_STEPS), 1.0
+    return _loop_measurer(wf.step, state, MO_PAIR_REF), 1.0
+
+
+# ------------------------------------------------------------------ workload 4
+# Island model (beyond-reference headline: the reference's Ray workflow
+# replicates, it never migrates). 8 vmapped PSO islands with ring
+# migration vs ONE panmictic PSO at the same total budget (8x512 = 4096
+# evals/gen on the same Ackley), single chip. The "vs" side here is our
+# own panmictic workflow, NOT the reference, so this leg is excluded from
+# the geomean; its ratio answers "what does the island structure cost
+# per generation?" (the convergence side of the tradeoff is in
+# PERF_NOTES: islands buy diversity/restarts, not raw throughput).
+
+ISL_N, ISL_POP, ISL_DIM = 8, 512, 256
+ISL_PAIR = (20, 220)
+
+
+def bench_islands_ours():
+    from evox_tpu import IslandWorkflow
+    from evox_tpu.algorithms.so.pso import PSO
+    from evox_tpu.problems.numerical import Ackley
+
+    wf = IslandWorkflow(
+        PSO(
+            lb=-32.0 * jnp.ones(ISL_DIM),
+            ub=32.0 * jnp.ones(ISL_DIM),
+            pop_size=ISL_POP,
+        ),
+        Ackley(),
+        n_islands=ISL_N,
+        migrate_every=8,
+    )
+    state = wf.init(jax.random.PRNGKey(5))
+    return _run_measurer(wf, state, ISL_PAIR), ISL_N * ISL_POP
+
+
+def bench_islands_panmictic():
+    from evox_tpu import StdWorkflow
+    from evox_tpu.algorithms.so.pso import PSO
+    from evox_tpu.problems.numerical import Ackley
+
+    algo = PSO(
+        lb=-32.0 * jnp.ones(ISL_DIM),
+        ub=32.0 * jnp.ones(ISL_DIM),
+        pop_size=ISL_N * ISL_POP,
+    )
+    wf = StdWorkflow(algo, Ackley())
+    state = wf.init(jax.random.PRNGKey(5))
+    return _run_measurer(wf, state, ISL_PAIR), ISL_N * ISL_POP
 
 
 # ----------------------------------------------------------------------- main
@@ -355,6 +442,12 @@ ROOFLINES = {
         # step: T * 4 * 20945 bytes — the roofline the kernel removed)
         "flops_per_eval": W_MAXLEN * (2 * (244 * 64 + 64 * 64 + 64 * 17) + 7500),
         "bytes_per_eval": 4 * 20945,
+    },
+    "islands": {
+        # per eval: Ackley ~7 flops/dim + PSO update ~10 flops/dim;
+        # per-island state streamed a few times per generation
+        "flops_per_eval": 17 * ISL_DIM,
+        "bytes_per_eval": 6 * 4 * ISL_DIM,
     },
     "nsga2": {
         # per gen at N=2*pop merged: dominance build 2*N^2*m compares +
@@ -404,7 +497,27 @@ WORKLOADS = [
         None,  # no interleaved reference: vs_baseline stays null
         ROOFLINES["walker"],
     ),
+    (
+        f"IslandWorkflow evals/sec ({ISL_N}x{ISL_POP} PSO islands, ring "
+        f"migration every 8 gens, dim={ISL_DIM}; 'baseline' is OUR "
+        "panmictic PSO at the same total budget, NOT the reference — "
+        "excluded from the geomean; ratio = island structure's "
+        "per-generation cost)",
+        "evals/sec",
+        bench_islands_ours,
+        bench_islands_panmictic,
+        ROOFLINES["islands"],
+    ),
 ]
+
+# legs whose "baseline" is not the reference: reported, never geomeaned
+NON_REFERENCE_LEGS = {WORKLOADS[-1][0]}
+
+
+def _median(xs):
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
 
 
 def main() -> None:
@@ -421,13 +534,18 @@ def main() -> None:
             except Exception as e:  # baseline unavailable: report null, never fake parity
                 print(f"reference baseline failed ({metric}): {type(e).__name__}: {e}", file=sys.stderr)
                 measure_ref = None
-        # interleave rounds so tunnel-throughput drift hits both sides alike
-        ours_best, ref_best = float("inf"), float("inf")
+        # interleaved rounds: adjacent ours/ref timings share whatever
+        # tunnel/chip phase exists, and the differenced slope cancels the
+        # per-dispatch latency — per-round ratios are the robust signal,
+        # the median their robust aggregate, the spread the self-check
+        ours_ts, ratios = [], []
         for _ in range(INTERLEAVE_ROUNDS):
-            ours_best = min(ours_best, measure_ours())
+            t_ours = measure_ours()
+            if t_ours == t_ours:  # not NaN
+                ours_ts.append(t_ours)
             if measure_ref is not None:
                 try:
-                    ref_best = min(ref_best, measure_ref())
+                    t_ref = measure_ref()
                 except Exception as e:  # keep "ours"; report null baseline
                     print(
                         f"reference baseline failed ({metric}): "
@@ -435,13 +553,19 @@ def main() -> None:
                         file=sys.stderr,
                     )
                     measure_ref = None
-        ours = scale / ours_best
-        ref = scale / ref_best if ref_best < float("inf") else None  # keep partial baselines
+                    continue
+                if t_ours == t_ours and t_ref == t_ref:
+                    ratios.append(t_ref / t_ours)
+        ours = scale / _median(ours_ts)
+        ratio = _median(ratios) if ratios else None
         entry = {
             "metric": metric,
             "value": round(ours, 3),
             "unit": unit,
-            "vs_baseline": round(ours / ref, 3) if ref else None,
+            "vs_baseline": round(ratio, 3) if ratio else None,
+            # per-round ratio spread: a capture whose own spread exceeds
+            # ~±10% of its median is telling you it's noise-limited
+            "ratio_rounds": [round(r, 3) for r in ratios] or None,
             # roofline context (MFU-style): analytic flops/bytes per unit
             # of the metric and the achieved rates they imply
             "flops_per_eval": roofline["flops_per_eval"],
@@ -451,12 +575,16 @@ def main() -> None:
         }
         results.append(entry)
         print(json.dumps(entry), flush=True)
-    ratios = [r["vs_baseline"] for r in results if r["vs_baseline"]]
+    ratios = [
+        r["vs_baseline"]
+        for r in results
+        if r["vs_baseline"] and r["metric"] not in NON_REFERENCE_LEGS
+    ]
     geomean = math.exp(sum(math.log(r) for r in ratios) / len(ratios)) if ratios else None
     covered = ", ".join(
         r["metric"].split(" evals/sec")[0].split(" gens/sec")[0]
         for r in results
-        if r["vs_baseline"]
+        if r["vs_baseline"] and r["metric"] not in NON_REFERENCE_LEGS
     )
     print(
         json.dumps(

@@ -1,0 +1,98 @@
+"""LES standing on unseen non-quadratic tasks (VERDICT r4 task 8).
+
+The published evosax LES params are unobtainable offline (the reference
+loads `2023_03_les_v1.pkl` via pkgutil.get_data — reference
+les.py:232-233 — but no .pkl exists in the mounted tree and there is no
+egress), so the bundled in-repo meta-trained artifact substitutes for
+them. This test pins where that artifact stands OUTSIDE its training
+distribution: official CEC2022 members at d=10 (shifted/rotated Zakharov
+and Levy, and the F6 hybrid — none of these families appear in
+les_meta.py's training draw), against OpenES and CMA-ES at an equal
+evaluation budget. The measured table lives in docs/PERF_NOTES.md §16.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu.algorithms.so.es import LES, OpenES, CMAES
+from evox_tpu.algorithms.so.es.les_meta import load_params
+from evox_tpu.problems.numerical import cec2022
+from evox_tpu.utils import rank_based_fitness
+
+DIM, POP, GENS, SEEDS = 10, 16, 100, 3
+FUNCS = (cec2022.F1, cec2022.F5, cec2022.F6)
+
+
+def _run(algo, prob, key, shape_fitness):
+    state = algo.init(key)
+    pstate = prob.init(key)
+
+    def gen(carry, _):
+        state, best = carry
+        cand, state = algo.ask(state)
+        cand = jnp.clip(cand, -100.0, 100.0)
+        fit, _ = prob.evaluate(pstate, cand)
+        state = algo.tell(
+            state, rank_based_fitness(fit) if shape_fitness else fit
+        )
+        return (state, jnp.minimum(best, jnp.min(fit))), None
+
+    (state, best), _ = jax.lax.scan(
+        gen, (state, jnp.inf), length=GENS
+    )
+    return jnp.log10(best + 1e-8)
+
+
+def test_les_cec2022_standing():
+    """On the unseen CEC2022 members the meta-trained LES must (a) beat
+    OpenES, its closest algorithmic relative, at the same budget on EVERY
+    member, and (b) beat the random-params LES in aggregate (per-member
+    with a noise margin — on F1/Zakharov both LES variants plateau at the
+    same basin, measured gap ~0). CMA-ES is reported, not asserted: it
+    wins the multimodal members at this budget (measured standings in
+    PERF_NOTES §17) — a standing the published evosax params share on
+    small-budget multimodal suites, per the LES paper's own ablations."""
+    params = load_params()
+    assert params is not None
+    center = jnp.zeros(DIM)
+    totals = {"les_trained": 0.0, "les_random": 0.0}
+    for fcls in FUNCS:
+        prob = fcls()
+
+        def mean_score(make):
+            tot = 0.0
+            for seed in range(SEEDS):
+                algo, shape = make()
+                tot += float(_run(algo, prob, jax.random.PRNGKey(seed), shape))
+            return tot / SEEDS
+
+        scores = {
+            "les_trained": mean_score(
+                lambda: (LES(center, init_stdev=30.0, pop_size=POP, params=params), False)
+            ),
+            "les_random": mean_score(
+                lambda: (LES(center, init_stdev=30.0, pop_size=POP, params=None), False)
+            ),
+            "openes": mean_score(
+                lambda: (
+                    OpenES(center, POP, learning_rate=3.0, noise_stdev=10.0),
+                    True,
+                )
+            ),
+            "cmaes": mean_score(
+                lambda: (CMAES(center, init_stdev=30.0, pop_size=POP), False)
+            ),
+        }
+        print(
+            f"{fcls.__name__}: "
+            + ", ".join(f"{k}={v:.2f}" for k, v in scores.items())
+        )
+        assert scores["les_trained"] < scores["openes"], (fcls.__name__, scores)
+        assert scores["les_trained"] < scores["les_random"] + 0.2, (
+            fcls.__name__,
+            scores,
+        )
+        totals["les_trained"] += scores["les_trained"]
+        totals["les_random"] += scores["les_random"]
+    assert totals["les_trained"] < totals["les_random"], totals
