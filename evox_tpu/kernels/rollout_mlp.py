@@ -264,7 +264,7 @@ def chain_walker_planes(**kwargs) -> PlaneEnv:
 # ------------------------------------------------------------------ kernel
 
 
-def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes) -> jax.Array:
+def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes, linear=()) -> jax.Array:
     """(act_dim, tile) actions; per-individual matvecs as static loops of
     full-width (fan_out, tile) FMAs (weights differ per lane -> no MXU).
 
@@ -273,7 +273,12 @@ def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes) -> jax.Array:
     Measured at walker scale this is throughput-NEUTRAL (the load-byte
     saving is offset by the widening converts — PERF_NOTES §11); what
     bf16 buys is a 2x per-tile policy budget and half the per-episode
-    HBM weight traffic."""
+    HBM weight traffic.
+
+    ``linear``: layer indices whose output skips the tanh — consecutive
+    linear layers express a low-rank factorization (a rank-r input layer
+    is ``sizes=(obs, r, h, ...), linear=(0,)``), the PERF_NOTES §14
+    "fewer MACs" lever. Matches ``mlp_policy(linear_layers=...)``."""
     h = obs
     n_layers = len(sizes) - 1
     for li in range(n_layers):
@@ -282,7 +287,7 @@ def _mlp_planes(w_refs, b_refs, obs: jax.Array, sizes) -> jax.Array:
         w = w_refs[li]
         for k in range(fan_in):
             acc = acc + h[k : k + 1] * w[k].astype(jnp.float32)
-        h = jnp.tanh(acc) if li < n_layers - 1 else acc
+        h = acc if (li == n_layers - 1 or li in linear) else jnp.tanh(acc)
     return h
 
 
@@ -296,6 +301,7 @@ def _rollout_mlp_kernel(
     obs_planes: Callable,
     state_keys: Tuple[str, ...],
     early_stop: bool,
+    linear: Tuple[int, ...] = (),
 ):
     n_layers = len(sizes) - 1
     w_refs = refs[:n_layers]
@@ -309,7 +315,7 @@ def _rollout_mlp_kernel(
 
     def body(state, done, total):
         obs = obs_planes(state)
-        act = _mlp_planes(w_refs, b_refs, obs, sizes)
+        act = _mlp_planes(w_refs, b_refs, obs, sizes, linear)
         state, reward, step_done = step_planes(state, act)
         total = total + jnp.where(done > 0.5, 0.0, reward)
         done = jnp.maximum(done, step_done.astype(done.dtype))
@@ -372,7 +378,7 @@ def _rollout_mlp_kernel(
     jax.jit,
     static_argnames=(
         "T", "sizes", "step_planes", "obs_planes", "tile", "episodes",
-        "early_stop", "interpret", "weight_dtype",
+        "early_stop", "interpret", "weight_dtype", "linear",
     ),
 )
 def fused_mlp_rollout(
@@ -388,6 +394,7 @@ def fused_mlp_rollout(
     early_stop: bool = True,
     interpret: bool = False,
     weight_dtype: Any = None,
+    linear: Tuple[int, ...] = (),
 ) -> jax.Array:
     """Total episode reward per env, fully fused, weights VMEM-resident.
 
@@ -409,6 +416,8 @@ def fused_mlp_rollout(
             inner loop re-streams the weight planes from VMEM every env
             step, so bf16 both halves that bandwidth (the kernel's
             roofline) and doubles the per-tile policy budget.
+        linear: layer indices with no tanh after them (low-rank
+            factorized layers — see :func:`_mlp_planes`).
 
     Returns:
         ``(episodes * n,)`` total rewards, episode-major (always f32).
@@ -456,6 +465,7 @@ def fused_mlp_rollout(
         obs_planes=obs_planes,
         state_keys=state_keys,
         early_stop=early_stop,
+        linear=linear,
     )
 
     def wrapped(*refs):

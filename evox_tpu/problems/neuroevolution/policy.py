@@ -59,6 +59,7 @@ def mlp_policy(
     activation: Callable = jnp.tanh,
     final_activation: Callable | None = None,
     use_matmul: bool | None = None,
+    linear_layers: Sequence[int] = (),
 ) -> Tuple[Callable, Callable]:
     """Build an MLP ``(init_params, apply)`` pair.
 
@@ -68,10 +69,17 @@ def mlp_policy(
     fill MXU tiles, broadcast-multiply-reduce for the tiny layers where a
     per-individual batched matmul pads catastrophically (module docstring).
     Force with True/False.
+    ``linear_layers``: indices of layers with NO activation after them.
+    Two consecutive layers with the first linear express a low-rank
+    factorized weight (``layer_sizes=(obs, r, h, act), linear_layers=(0,)``
+    is a rank-r input layer) — same obs/act at a fraction of the MACs and
+    genome dim; the fused kernel mirrors this via
+    ``fused_mlp_rollout(linear=...)``.
     """
     sizes = tuple(int(s) for s in layer_sizes)
     if len(sizes) < 2:
         raise ValueError("layer_sizes needs at least (in, out)")
+    linear_set = frozenset(int(i) for i in linear_layers)
     # MXU tiles are 128x128; a (fan_in, fan_out) this small occupies a
     # fraction of one tile per individual, so the VPU form wins
     layer_matmul = tuple(
@@ -99,7 +107,9 @@ def mlp_policy(
                 # broadcast-multiply-reduce == h @ w, but VPU-friendly
                 # under per-individual vmap (see module docstring)
                 h = jnp.sum(h[..., :, None] * layer["w"], axis=-2) + layer["b"]
-            if i < len(params) - 1:
+            if i in linear_set:
+                pass
+            elif i < len(params) - 1:
                 h = activation(h)
             elif final_activation is not None:
                 h = final_activation(h)

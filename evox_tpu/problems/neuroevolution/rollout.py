@@ -195,6 +195,10 @@ class PolicyRolloutProblem(Problem):
             kernel's VMEM-bandwidth roofline and doubles the per-tile
             policy budget; accumulation and env math stay f32). None
             keeps f32 residency.
+        fused_planes_linear: layer indices with no tanh after them, matching
+            the policy's ``mlp_policy(linear_layers=...)`` — expresses
+            low-rank factorized layers in the big-policy kernel (the
+            PERF_NOTES §18 fewer-MACs lever).
     """
 
     def __init__(
@@ -215,6 +219,7 @@ class PolicyRolloutProblem(Problem):
         fused_planes: Optional["PlaneEnv"] = None,
         fused_planes_tile: int = 128,
         fused_planes_dtype: Any = None,
+        fused_planes_linear: Tuple[int, ...] = (),
     ):
         self.policy = policy
         self.env = env
@@ -250,6 +255,7 @@ class PolicyRolloutProblem(Problem):
         self.fused_planes = fused_planes
         self.fused_planes_tile = fused_planes_tile
         self.fused_planes_dtype = fused_planes_dtype
+        self.fused_planes_linear = tuple(int(i) for i in fused_planes_linear)
         self._fused_policy_checked = False
 
     def _check_fused_base(self, base, name: str) -> None:
@@ -426,6 +432,7 @@ class PolicyRolloutProblem(Problem):
             early_stop=self.fused_planes.terminating,
             interpret=interpret,
             weight_dtype=self.fused_planes_dtype,
+            linear=self.fused_planes_linear,
         )
         fitness = self.reduce_fn(totals.reshape(ep, pop_size).T, axis=-1)
         return fitness, RolloutState(key=key, cap=state.cap, norm=state.norm)
@@ -455,7 +462,13 @@ class PolicyRolloutProblem(Problem):
             w_refs = [l["w"][:, :, None] for l in params]  # (in, out, 1)
             b_refs = [l["b"][:, None] for l in params]  # (out, 1)
             want = np.asarray(
-                _mlp_planes(w_refs, b_refs, obs[:, None], tuple(sizes))
+                _mlp_planes(
+                    w_refs,
+                    b_refs,
+                    obs[:, None],
+                    tuple(sizes),
+                    self.fused_planes_linear,
+                )
             ).reshape(-1)
             got = np.asarray(self.policy(params, obs)).reshape(-1)
         if got.shape != want.shape or not np.allclose(

@@ -183,6 +183,35 @@ def test_fused_planes_multichip_shard_map():
     np.testing.assert_allclose(centers[0], centers[1], rtol=1e-4, atol=1e-4)
 
 
+def test_fused_planes_low_rank_linear_matches_scan():
+    """A rank-r factorized input layer (linear_layers=(0,)) runs through
+    the fused kernel bit-compatibly with the scan engine — the PERF_NOTES
+    §18 fewer-MACs structured policy."""
+    penv = chain_walker_planes(max_steps=20)
+    init_params, apply = mlp_policy((244, 8, 16, 17), linear_layers=(0,))
+    adapter = TreeAndVector(init_params(jax.random.PRNGKey(0)))
+    pop_flat = 0.2 * jax.random.normal(jax.random.PRNGKey(5), (6, adapter.dim))
+    pop_tree = jax.vmap(adapter.to_tree)(pop_flat)
+
+    kw = dict(num_episodes=2, stochastic_reset=False)
+    scan_prob = PolicyRolloutProblem(apply, penv.base, **kw)
+    fused_prob = PolicyRolloutProblem(
+        apply, penv.base, fused_planes=penv, fused_interpret=True,
+        fused_planes_linear=(0,), **kw
+    )
+    f_scan, _ = scan_prob.evaluate(scan_prob.init(jax.random.PRNGKey(9)), pop_tree)
+    f_fused, _ = fused_prob.evaluate(fused_prob.init(jax.random.PRNGKey(9)), pop_tree)
+    np.testing.assert_allclose(
+        np.asarray(f_fused), np.asarray(f_scan), rtol=2e-3, atol=2e-3
+    )
+    # and the probe rejects a mismatched linear spec
+    bad = PolicyRolloutProblem(
+        apply, penv.base, fused_planes=penv, fused_interpret=True, **kw
+    )
+    with pytest.raises(ValueError, match="disagrees"):
+        bad.evaluate(bad.init(jax.random.PRNGKey(9)), pop_tree)
+
+
 def test_fused_planes_rejects_wrong_policy():
     penv = chain_walker_planes(max_steps=10)
     init_params, apply = mlp_policy((244, 16, 8, 17), activation=jax.nn.relu)
