@@ -80,6 +80,14 @@ def mlp_policy(
     if len(sizes) < 2:
         raise ValueError("layer_sizes needs at least (in, out)")
     linear_set = frozenset(int(i) for i in linear_layers)
+    # a typo'd (or negative) index would be silently ignored by BOTH this
+    # policy and the fused kernel's identical loop — the consistency probe
+    # would pass while the user trains a different architecture
+    if not linear_set <= set(range(len(sizes) - 1)):
+        raise ValueError(
+            f"linear_layers {sorted(linear_set)} out of range for "
+            f"{len(sizes) - 1} layers (negative indices not supported)"
+        )
     # MXU tiles are 128x128; a (fan_in, fan_out) this small occupies a
     # fraction of one tile per individual, so the VPU form wins
     layer_matmul = tuple(

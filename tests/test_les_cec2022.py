@@ -13,7 +13,6 @@ evaluation budget. The measured table lives in docs/PERF_NOTES.md §16.
 
 import jax
 import jax.numpy as jnp
-import pytest
 
 from evox_tpu.algorithms.so.es import LES, OpenES, CMAES
 from evox_tpu.algorithms.so.es.les_meta import load_params
