@@ -34,13 +34,23 @@ def main() -> None:
     ap.add_argument("--gens", type=int, default=50)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--episode-len", type=int, default=200)
+    ap.add_argument(
+        "--rank", type=int, default=0,
+        help="low-rank factorize the input layer (0 = dense): rank 16 "
+        "measured 1.51x throughput at matched equal-wall-clock reward, "
+        "and halves the genome (PERF_NOTES §18)",
+    )
     args = ap.parse_args()
 
     penv = chain_walker_planes(max_steps=args.episode_len)
     env = penv.base
-    init_params, apply = mlp_policy(
-        (env.obs_dim, args.hidden, args.hidden, env.act_dim)
-    )
+    if args.rank:
+        sizes = (env.obs_dim, args.rank, args.hidden, args.hidden, env.act_dim)
+        linear = (0,)
+    else:
+        sizes = (env.obs_dim, args.hidden, args.hidden, env.act_dim)
+        linear = ()
+    init_params, apply = mlp_policy(sizes, linear_layers=linear)
     adapter = TreeAndVector(init_params(jax.random.PRNGKey(0)))
     print(f"policy dim: {adapter.dim}, pop: {args.pop}")
 
@@ -50,6 +60,7 @@ def main() -> None:
         num_episodes=1,
         stochastic_reset=False,
         fused_planes=penv,
+        fused_planes_linear=linear,
     )
     algo = OpenES(
         0.05 * jax.random.normal(jax.random.PRNGKey(1), (adapter.dim,)),

@@ -14,7 +14,7 @@ evaluation budget. The measured table lives in docs/PERF_NOTES.md §16.
 import jax
 import jax.numpy as jnp
 
-from evox_tpu.algorithms.so.es import LES, OpenES, CMAES
+from evox_tpu.algorithms.so.es import LES, OpenES
 from evox_tpu.algorithms.so.es.les_meta import load_params
 from evox_tpu.problems.numerical import cec2022
 from evox_tpu.utils import rank_based_fitness
@@ -79,9 +79,8 @@ def test_les_cec2022_standing():
                     True,
                 )
             ),
-            "cmaes": mean_score(
-                lambda: (CMAES(center, init_stdev=30.0, pop_size=POP), False)
-            ),
+            # CMA-ES is reported in PERF_NOTES §17, never asserted —
+            # re-running it here spent ~25% of the test for zero checks
         }
         print(
             f"{fcls.__name__}: "

@@ -90,7 +90,10 @@ def test_non_dominated_sort_sharded_matches_replicated():
 
     assert jax.device_count() >= 8
     mesh = create_mesh()
-    for n, m, until in [(100, 3, None), (256, 2, 128), (513, 4, 200), (33, 3, None)]:
+    # (33: fewer packed words than devices + until=None; 256: words
+    # divisible by the mesh + until; 513: non-divisible + until) — a
+    # fourth mid-size divisor case added no distinct layout regime
+    for n, m, until in [(256, 2, 128), (513, 4, 200), (33, 3, None)]:
         f = jax.random.normal(jax.random.PRNGKey(n), (n, m))
         r0, c0 = non_dominated_sort(f, until=until, return_cut_rank=True)
         r1, c1 = non_dominated_sort(f, until=until, return_cut_rank=True, mesh=mesh)

@@ -215,7 +215,7 @@ def test_sharded_selection_across_moea_families():
             wf = StdWorkflow(algo, prob, mesh=mesh_arg, num_objectives=m,
                              allow_uneven_shards=True)
             st = wf.init(jax.random.PRNGKey(5))
-            st = wf.run(st, 5)
+            st = wf.run(st, 3)
             return np.asarray(st.algo.fitness)
 
         np.testing.assert_allclose(
@@ -261,10 +261,7 @@ def test_sharded_selection_at_chunked_build_size():
     (random uniform fitness on m=3 yields dozens of fronts before the
     n/2 cut)."""
     from evox_tpu.kernels.dominance import _DENSE_BUILD_MAX_N
-    from evox_tpu.operators.selection.non_dominate import (
-        non_dominated_sort,
-        rank_crowding_truncate,
-    )
+    from evox_tpu.operators.selection.non_dominate import non_dominated_sort
 
     mesh = create_mesh()
     n, m = 20032, 3
@@ -281,11 +278,10 @@ def test_sharded_selection_at_chunked_build_size():
     assert int(cut_rep) == int(cut_sh)
     assert int(cut_rep) >= 2  # multiple peel iterations actually ran
     np.testing.assert_array_equal(np.asarray(rank_rep), np.asarray(rank_sh))
-
-    order_rep, ranks_rep = rank_crowding_truncate(fitness, k)
-    order_sh, ranks_sh = rank_crowding_truncate(fitness, k, mesh=mesh)
-    np.testing.assert_array_equal(np.asarray(order_rep), np.asarray(order_sh))
-    np.testing.assert_array_equal(np.asarray(ranks_rep), np.asarray(ranks_sh))
+    # truncate x mesh equivalence is covered at smaller size by
+    # test_mo_operators.py::test_rank_crowding_truncate_sharded_matches_
+    # replicated; repeating it at n=20032 would double this test's O(n^2)
+    # cost without touching the chunked-build interaction under test
 
 
 def test_uneven_pop_sharding_policy():
