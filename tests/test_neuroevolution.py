@@ -31,6 +31,24 @@ def _cartpole_setup(hidden=8):
     return env, apply, adapter
 
 
+def test_mlp_policy_linear_layers_validated():
+    """Out-of-range (or negative) linear_layers indices raise instead of
+    being silently ignored in lockstep by both engines; a valid index
+    skips the activation (output is the raw affine map)."""
+    with pytest.raises(ValueError, match="out of range"):
+        mlp_policy((4, 8, 2), linear_layers=(2,))
+    with pytest.raises(ValueError, match="out of range"):
+        mlp_policy((4, 8, 2), linear_layers=(-1,))
+    init_params, apply = mlp_policy((4, 8, 2), linear_layers=(0,))
+    params = init_params(jax.random.PRNGKey(0))
+    obs = jnp.arange(4.0)
+    h_lin = obs @ params[0]["w"] + params[0]["b"]  # NOT tanh'd
+    want = h_lin @ params[1]["w"] + params[1]["b"]
+    np.testing.assert_allclose(
+        np.asarray(apply(params, obs)), np.asarray(want), rtol=1e-6
+    )
+
+
 def test_cartpole_policy_trains():
     """PSO + MLP solves cartpole (reward >= 400 of max 500)."""
     env, apply, adapter = _cartpole_setup()
