@@ -15,7 +15,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # compile-bound on one core, and this halves compile-heavy files
 # (test_islands 90s -> 46s) while execution-heavy ones stay within ~5%
 # (the n=20032 chunked-build test 68 -> 72s). With the shape trims the
-# suite runs ~21 min single-process (20:49-22:08 observed; was 28) with
+# suite runs ~21 min single-process (18:57-22:08 observed; was 28) with
 # identical assertions. TPU runs are unaffected (flag is CPU-test only,
 # set here).
 _flags = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
