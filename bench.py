@@ -419,6 +419,43 @@ def bench_islands_panmictic():
     return _run_measurer(wf, state, ISL_PAIR), ISL_N * ISL_POP
 
 
+# ---------------------------------------------------------- run telemetry
+# Structured observability sample embedded in the BENCH_*.json summary: a
+# small instrumented workload (deliberately separate from the timed legs,
+# so instrumentation never perturbs the ratios) whose run_report carries
+# (a) the on-device TelemetryMonitor counters — best/mean trajectory,
+# NaN/Inf counts, stagnation — and (b) the host-side per-entry-point
+# compile vs dispatch timings, which on the tunneled chip directly expose
+# the 45-100 ms round-trip this file's differenced protocol exists to
+# cancel. Axon-safe: the monitor is callback-free and the recorder times
+# around dispatch only.
+
+TEL_GENS = 30
+
+
+def telemetry_report():
+    from evox_tpu import StdWorkflow, instrument, run_report
+    from evox_tpu.algorithms.so.pso import PSO
+    from evox_tpu.monitors import TelemetryMonitor
+    from evox_tpu.problems.numerical import Ackley
+
+    dim = 64
+    tm = TelemetryMonitor(capacity=TEL_GENS)
+    wf = StdWorkflow(
+        PSO(lb=-32.0 * jnp.ones(dim), ub=32.0 * jnp.ones(dim), pop_size=256),
+        Ackley(),
+        monitors=(tm,),
+    )
+    rec = instrument(wf)
+    state = wf.init(jax.random.PRNGKey(11))
+    state = wf.run(state, TEL_GENS)  # one fused dispatch (cold: compile)
+    state = wf.run(state, TEL_GENS)  # warm dispatch for the steady sample
+    for _ in range(3):
+        state = wf.step(state)  # per-step dispatch cost, warm
+    rec.fetch(state.algo.gbest_fitness, name="gbest_fitness")
+    return run_report(wf, state, recorder=rec)
+
+
 # ----------------------------------------------------------------------- main
 
 # Analytic roofline estimates per unit of the workload's metric (one eval,
@@ -619,6 +656,14 @@ def main() -> None:
         for r in results
         if r["vs_baseline"] and r["metric"] not in NON_REFERENCE_LEGS
     )
+    try:
+        report = telemetry_report()
+    except Exception as e:  # observability must never sink the bench
+        print(
+            f"telemetry report failed: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        report = None
     print(
         json.dumps(
             {
@@ -627,6 +672,7 @@ def main() -> None:
                 "unit": "x",
                 "vs_baseline": round(geomean, 3) if geomean else None,
                 "sub_metrics": results,
+                "run_report": report,
             }
         )
     )

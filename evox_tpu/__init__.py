@@ -19,6 +19,10 @@ from .core import (
     pytree_dataclass,
     create_mesh,
     POP_AXIS,
+    DispatchRecorder,
+    instrument,
+    run_report,
+    write_report_jsonl,
 )
 from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
 from .workflows import IslandWorkflow, StdWorkflow, run_host_pipelined
@@ -33,6 +37,10 @@ __all__ = [
     "pytree_dataclass",
     "create_mesh",
     "POP_AXIS",
+    "DispatchRecorder",
+    "instrument",
+    "run_report",
+    "write_report_jsonl",
     "StdWorkflow",
     "IslandWorkflow",
     "run_host_pipelined",

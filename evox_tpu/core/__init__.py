@@ -16,9 +16,19 @@ from .distributed import (
     process_count,
     is_dist_initialized,
 )
+from .instrument import (
+    DispatchRecorder,
+    instrument,
+    run_report,
+    write_report_jsonl,
+)
 from . import state_io
 
 __all__ = [
+    "DispatchRecorder",
+    "instrument",
+    "run_report",
+    "write_report_jsonl",
     "PyTreeNode",
     "field",
     "static_field",

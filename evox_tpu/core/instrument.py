@@ -1,0 +1,254 @@
+"""Host-side dispatch instrumentation for workflow entry points.
+
+The device half of observability (TelemetryMonitor) lives inside the
+jitted step; this module is the host half. It wraps a workflow's jitted
+entry points (``init`` / ``step`` / ``run`` / ``pipeline_ask`` /
+``pipeline_tell``) with plain wall-clock timing *around the dispatch* —
+never inside traced code, so it is safe on every backend including the
+axon-tunneled TPU, and on that backend it directly measures the
+45-100 ms per-dispatch tunnel round-trip that bench.py documents.
+
+Semantics under JAX's async dispatch: a warm call returns once the work
+is *dispatched*, so its duration is the host-side dispatch cost (on the
+tunneled chip: the round-trip latency). The first call of an entry point
+additionally pays trace + compile, which dominates it — the summary
+reports that first call separately (``first_call_s``) plus an estimated
+``compile_s`` (first call minus the steady-state median) alongside the
+steady-state dispatch statistics. Host fetches go through
+:meth:`DispatchRecorder.fetch`, which accounts bytes and seconds per
+fetch site (a big-array fetch costs real tunnel time, ~6.6 s/256 MB —
+the reason bench.py fetches a small leaf).
+
+``run_report`` merges this host-side summary with the device counters of
+any attached monitor exposing ``report(mstate)`` (TelemetryMonitor) into
+one JSON-serializable dict; ``write_report_jsonl`` appends it to a
+JSON-lines file.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = [
+    "DispatchRecorder",
+    "instrument",
+    "run_report",
+    "sanitize_json",
+    "write_report_jsonl",
+]
+
+
+def sanitize_json(obj: Any) -> Any:
+    """Recursively replace non-finite floats with ``None`` so the result
+    is STRICT (RFC 8259) JSON — ``json.dumps`` would otherwise emit bare
+    ``Infinity``/``NaN`` tokens that ``jq``/``JSON.parse`` reject. Inf/NaN
+    legitimately appear in telemetry (the +inf best before any finite
+    generation, inf-padded ring slots of an all-poison generation)."""
+    if isinstance(obj, dict):
+        return {k: sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [sanitize_json(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
+
+# bound methods wrapped on the workflow INSTANCE, so instrumentation is
+# per-workflow and never leaks into other workflows of the same class
+DEFAULT_ENTRY_POINTS = (
+    "init",
+    "step",
+    "run",
+    "pipeline_ask",
+    "pipeline_tell",
+)
+
+
+class _EntryStats:
+    __slots__ = ("times",)
+
+    def __init__(self) -> None:
+        self.times: list = []  # call durations, [0] is the cold call
+
+    def summary(self) -> dict:
+        first = self.times[0]
+        steady = self.times[1:]
+        out = {
+            "calls": len(self.times),
+            "first_call_s": round(first, 6),
+            "total_s": round(sum(self.times), 6),
+        }
+        if steady:
+            p50 = float(np.percentile(steady, 50))
+            out["dispatch_s"] = {
+                "mean": round(float(np.mean(steady)), 6),
+                "p50": round(p50, 6),
+                "min": round(float(np.min(steady)), 6),
+                "max": round(float(np.max(steady)), 6),
+            }
+            # the cold call = trace + compile + one dispatch; subtracting
+            # the steady median leaves a compile estimate (floored: noise
+            # can invert it for trivially small programs)
+            out["compile_s"] = round(max(first - p50, 0.0), 6)
+        else:
+            out["dispatch_s"] = None
+            out["compile_s"] = round(first, 6)
+        return out
+
+
+class DispatchRecorder:
+    """Per-entry-point wall-clock registry; all accounting host-side."""
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._entries: Dict[str, _EntryStats] = {}
+        self._fetches: Dict[str, dict] = {}
+        self._created = clock()
+
+    # ------------------------------------------------------------- recording
+    @contextlib.contextmanager
+    def record(self, name: str):
+        """Time a host-side block as one call of entry point ``name``."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            self._entries.setdefault(name, _EntryStats()).times.append(dt)
+
+    def wrap(self, name: str, fn: Callable) -> Callable:
+        """Wrap ``fn`` so every call is recorded under ``name``."""
+
+        def wrapped(*args: Any, **kwargs: Any):
+            with self.record(name):
+                return fn(*args, **kwargs)
+
+        wrapped._dispatch_recorder = self  # idempotence marker for attach
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    def attach(
+        self,
+        workflow: Any,
+        entry_points: Sequence[str] = DEFAULT_ENTRY_POINTS,
+    ) -> Any:
+        """Wrap the workflow's entry points in place (instance attributes
+        shadow the class methods; other instances are untouched). Note
+        that ``run`` internally peels its first generation through
+        ``step``, so one ``run`` call also records one ``step`` call —
+        that peeled dispatch is real and reported where it happens.
+        Re-attaching the same recorder is a no-op per entry point."""
+        for name in entry_points:
+            fn = getattr(workflow, name, None)
+            if fn is None or not callable(fn):
+                continue
+            if getattr(fn, "_dispatch_recorder", None) is self:
+                continue
+            setattr(workflow, name, self.wrap(name, fn))
+        return workflow
+
+    def fetch(self, tree: Any, name: str = "fetch") -> Any:
+        """Bring ``tree`` to host, accounting bytes and seconds under
+        ``name``. Returns the numpy-leaved tree. This is the ONLY place
+        instrumented code should materialize device data — fetch bytes
+        are the tunnel-cost currency on the axon backend."""
+        t0 = self._clock()
+        host = jax.device_get(tree)
+        dt = self._clock() - t0
+        nbytes = int(
+            sum(
+                x.nbytes
+                for x in jax.tree.leaves(host)
+                if hasattr(x, "nbytes")
+            )
+        )
+        agg = self._fetches.setdefault(
+            name, {"calls": 0, "bytes": 0, "seconds": 0.0}
+        )
+        agg["calls"] += 1
+        agg["bytes"] += nbytes
+        agg["seconds"] += dt
+        return host
+
+    # --------------------------------------------------------------- summary
+    def summary(self) -> dict:
+        return {
+            "entry_points": {
+                name: stats.summary()
+                for name, stats in sorted(self._entries.items())
+            },
+            "fetches": {
+                name: {
+                    "calls": agg["calls"],
+                    "bytes": agg["bytes"],
+                    "seconds": round(agg["seconds"], 6),
+                }
+                for name, agg in sorted(self._fetches.items())
+            },
+            "wall_s": round(self._clock() - self._created, 6),
+        }
+
+
+def instrument(
+    workflow: Any,
+    recorder: Optional[DispatchRecorder] = None,
+    entry_points: Sequence[str] = DEFAULT_ENTRY_POINTS,
+) -> DispatchRecorder:
+    """Attach (or create) a :class:`DispatchRecorder` to ``workflow``.
+
+    Usage::
+
+        rec = instrument(wf)
+        state = wf.init(key)
+        state = wf.run(state, 100)
+        report = run_report(wf, state, recorder=rec)
+    """
+    recorder = recorder if recorder is not None else DispatchRecorder()
+    recorder.attach(workflow, entry_points)
+    return recorder
+
+
+def run_report(
+    workflow: Any = None,
+    state: Any = None,
+    recorder: Optional[DispatchRecorder] = None,
+    extra: Optional[dict] = None,
+) -> dict:
+    """Merge device telemetry and host dispatch timings into ONE
+    JSON-serializable dict.
+
+    Device side: every monitor on ``workflow`` exposing ``report(mstate)``
+    (duck-typed, so core never imports monitors) is called with its slot
+    of ``state.monitors``. Host side: ``recorder.summary()``. Either half
+    may be absent — a report can cover a bare recorder or a bare
+    workflow+state.
+    """
+    report: dict = {"schema": "evox_tpu.run_report/v1"}
+    if state is not None and hasattr(state, "generation"):
+        report["generation"] = int(state.generation)
+    if workflow is not None and state is not None:
+        telemetry = []
+        for i, mon in enumerate(getattr(workflow, "monitors", ())):
+            if hasattr(mon, "report"):
+                entry = mon.report(state.monitors[i])
+                entry["monitor"] = type(mon).__name__
+                entry["monitor_index"] = i
+                telemetry.append(entry)
+        report["telemetry"] = telemetry
+    if recorder is not None:
+        report["dispatch"] = recorder.summary()
+    if extra:
+        report["extra"] = dict(extra)
+    return sanitize_json(report)
+
+
+def write_report_jsonl(report: dict, path: str) -> None:
+    """Append ``report`` as one strict-JSON line to a JSON-lines file."""
+    with open(path, "a") as f:
+        f.write(json.dumps(sanitize_json(report), allow_nan=False) + "\n")

@@ -430,6 +430,14 @@ def fused_mlp_rollout(
         raise ValueError(f"tile must be a multiple of {_LANES}, got {tile}")
     n_layers = len(sizes) - 1
     assert len(weights) == n_layers and len(biases) == n_layers
+    # mirror mlp_policy(linear_layers=...): a typo'd (or negative) index
+    # would be silently ignored by _mlp_planes' loop and the user would
+    # train a different architecture than they asked for
+    if not set(linear) <= set(range(n_layers)):
+        raise ValueError(
+            f"linear {sorted(set(linear))} out of range for {n_layers} "
+            "layers (negative indices not supported)"
+        )
     if weight_dtype is not None:
         weights = tuple(w.astype(weight_dtype) for w in weights)
         biases = tuple(b.astype(weight_dtype) for b in biases)

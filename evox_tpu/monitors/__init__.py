@@ -3,6 +3,8 @@ from .pop_monitor import PopMonitor
 from .evoxvis_monitor import EvoXVisMonitor
 from .checkpoint_monitor import CheckpointMonitor
 from .profiler import StepTimerMonitor, trace as profiler_trace
+from .telemetry import TelemetryMonitor, TelemetryState
+from .common import backend_supports_callbacks
 from . import profiler
 
 __all__ = [
@@ -12,6 +14,9 @@ __all__ = [
     "EvoXVisMonitor",
     "CheckpointMonitor",
     "StepTimerMonitor",
+    "TelemetryMonitor",
+    "TelemetryState",
+    "backend_supports_callbacks",
     "profiler_trace",
     "profiler",
 ]

@@ -12,3 +12,21 @@ def host0_sharding() -> SingleDeviceSharding:
     that owns device 0), the same discipline as the reference
     (eval_monitor.py:69 ``SingleDeviceSharding(jax.devices()[0])``)."""
     return SingleDeviceSharding(jax.devices()[0])
+
+
+# Backends whose runtimes cannot execute host callbacks (io_callback /
+# pure_callback): the tunneled axon TPU plugin. Anything built on host
+# callbacks must fail loudly at init/trace time there instead of hanging
+# inside the runtime (measured: the callback never completes). The plugin
+# reports platform "tpu"; its identity only shows in the PJRT client's
+# platform_version string ("axon x.y.z; ...").
+CALLBACK_LESS_MARKERS = ("axon",)
+
+
+def backend_supports_callbacks() -> bool:
+    """True when the default backend can run io_callback/pure_callback."""
+    try:
+        version = getattr(jax.devices()[0].client, "platform_version", "")
+    except Exception:  # pragma: no cover - backend probing must never fail
+        return True
+    return not any(m in version for m in CALLBACK_LESS_MARKERS)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.experimental import io_callback
 
 from ..core.monitor import Monitor
-from .common import host0_sharding
+from .common import backend_supports_callbacks, host0_sharding
 from ..core.struct import PyTreeNode
 from ..operators.selection.non_dominate import (
     crowding_distance,
@@ -38,21 +38,12 @@ class EvalMonitorState(PyTreeNode):
     hist_count: Optional[jax.Array] = None  # () int32 total generations seen
 
 
-# Backends whose runtimes cannot execute host callbacks (io_callback /
-# pure_callback): the tunneled axon TPU plugin. full_*_history relies on
-# io_callback, so it must fail loudly at trace time there instead of
-# hanging inside the runtime (measured: the callback never completes).
-# The plugin reports platform "tpu"; its identity only shows in the PJRT
-# client's platform_version string ("axon x.y.z; ...").
-_CALLBACK_LESS_MARKERS = ("axon",)
-
-
-def _default_backend_supports_callbacks() -> bool:
-    try:
-        version = getattr(jax.devices()[0].client, "platform_version", "")
-    except Exception:  # pragma: no cover - backend probing must never fail
-        return True
-    return not any(m in version for m in _CALLBACK_LESS_MARKERS)
+# Backward-compat alias: the probe now lives in monitors/common.py so every
+# callback-dependent monitor (StepTimerMonitor included) shares one marker
+# list. full_*_history relies on io_callback, so it must fail loudly at
+# trace time on callback-less backends instead of hanging inside the
+# runtime (measured: the callback never completes).
+_default_backend_supports_callbacks = backend_supports_callbacks
 
 
 class EvalMonitor(Monitor):

@@ -22,11 +22,18 @@ import numpy as np
 from jax.experimental import io_callback
 
 from ..core.monitor import Monitor
-from .common import host0_sharding
+from .common import backend_supports_callbacks, host0_sharding
 
 
 class StepTimerMonitor(Monitor):
-    """Records wall-clock duration of every generation."""
+    """Records wall-clock duration of every generation.
+
+    Requires a backend that can execute host callbacks — NOT the tunneled
+    axon TPU plugin. ``init()`` probes the backend (the same
+    platform_version probe EvalMonitor's full history uses) and fails with
+    a pointer to the callback-free alternatives instead of the opaque
+    trace-time error the raw ``io_callback`` would produce.
+    """
 
     def __init__(self):
         self.start_times: list = []
@@ -34,6 +41,18 @@ class StepTimerMonitor(Monitor):
 
     def hooks(self):
         return ("pre_step", "post_step")
+
+    def init(self, key=None):
+        if not backend_supports_callbacks():
+            raise RuntimeError(
+                "StepTimerMonitor times generations with ordered host "
+                "callbacks, which this backend (axon-tunneled TPU) cannot "
+                "execute. Use TelemetryMonitor (monitors/telemetry.py) for "
+                "on-device per-generation statistics and core.instrument."
+                "DispatchRecorder for host-side compile/dispatch wall-clock "
+                "— both are callback-free and axon-safe."
+            )
+        return None
 
     def pre_step(self, mstate: Any) -> Any:
         io_callback(

@@ -288,3 +288,26 @@ def test_bf16_rollouts_train_walker():
     state = wf.run(state, 10)
     after = center_reward(state)
     assert after > before, (before, after)
+
+
+def test_fused_mlp_rejects_out_of_range_linear():
+    """ADVICE round-5 regression: an out-of-range `linear` index used to
+    be silently ignored (the user would train a different architecture
+    than requested); fused_mlp_rollout now mirrors
+    mlp_policy(linear_layers=...)'s range check."""
+    n = 5
+    penv, planes0 = _walker_setup(n, max_steps=3)
+    weights, biases = _make_params(jax.random.PRNGKey(5), n)
+    kw = dict(
+        T=3, sizes=SIZES, step_planes=penv.step_planes,
+        obs_planes=penv.obs_planes, interpret=True,
+    )
+    n_layers = len(SIZES) - 1
+    for bad in ((n_layers,), (-1,), (0, 99)):
+        with pytest.raises(ValueError, match="out of range"):
+            fused_mlp_rollout(weights, biases, planes0, linear=bad, **kw)
+    # in-range indices still work
+    got = fused_mlp_rollout(
+        weights, biases, planes0, linear=(0,), **kw
+    )
+    assert got.shape == (n,)

@@ -1,0 +1,100 @@
+"""Static analysis: host callbacks must not creep into hot-path modules.
+
+The axon-tunneled TPU backend cannot execute io_callback / pure_callback
+(CLAUDE.md), and jax.debug.* lowers to the same host-callback machinery —
+any of them in traced code makes the module unusable on the real target
+hardware. This test AST-scans every module under evox_tpu/ and fails if a
+callback primitive appears outside the explicit allowlist of host-only
+modules, so new code cannot silently reintroduce axon-incompatible hot
+paths. Docstrings and comments never trigger it (AST, not grep).
+
+The allowlist is also checked for staleness: an entry whose module no
+longer uses callbacks must be removed, keeping the host-only surface
+exactly as small as it really is.
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "evox_tpu"
+
+# Host-only modules whose PURPOSE is host traffic: monitors that stream
+# history/files to the host, and the declared host-problem paths. Each is
+# documented (GUIDE.md §6) as requiring a callback-capable backend.
+ALLOWED = {
+    "monitors/eval_monitor.py",  # full_*_history streaming (opt-in)
+    "monitors/pop_monitor.py",  # host-side population history
+    "monitors/evoxvis_monitor.py",  # Arrow IPC file streaming
+    "monitors/checkpoint_monitor.py",  # host checkpoint saves
+    "monitors/profiler.py",  # StepTimerMonitor (loud init() probe)
+    "workflows/common.py",  # callback_evaluate: external-problem contract
+    "problems/neuroevolution/hostenv.py",  # in-jit host env stepping
+    "problems/supervised/dataset.py",  # in-jit host batch source
+    "problems/evoxbench.py",  # host benchmark backend
+}
+
+CALLBACK_NAMES = {"io_callback", "pure_callback"}
+
+
+def _uses_host_callbacks(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        # from jax.experimental import io_callback / jax.pure_callback import
+        if isinstance(node, ast.ImportFrom):
+            if any(alias.name in CALLBACK_NAMES for alias in node.names):
+                return True
+        # bare or attribute references: io_callback(...), jax.pure_callback
+        elif isinstance(node, ast.Name) and node.id in CALLBACK_NAMES:
+            return True
+        elif isinstance(node, ast.Attribute):
+            if node.attr in CALLBACK_NAMES:
+                return True
+            # jax.debug.print / jax.debug.callback / jax.debug.breakpoint
+            v = node.value
+            if (
+                isinstance(v, ast.Attribute)
+                and v.attr == "debug"
+                and isinstance(v.value, ast.Name)
+                and v.value.id == "jax"
+            ):
+                return True
+    return False
+
+
+def _scan():
+    users = set()
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if _uses_host_callbacks(tree):
+            users.add(rel)
+    return users
+
+
+def test_no_host_callbacks_outside_allowlist():
+    users = _scan()
+    violations = users - ALLOWED
+    assert not violations, (
+        "host-callback primitives (io_callback/pure_callback/jax.debug) "
+        f"found outside the host-only allowlist: {sorted(violations)}. "
+        "These cannot run on the axon TPU backend — keep hot paths "
+        "callback-free (TelemetryMonitor/core.instrument patterns) or, "
+        "for a genuinely host-only module, extend the allowlist with a "
+        "justification comment."
+    )
+
+
+def test_allowlist_has_no_stale_entries():
+    users = _scan()
+    stale = ALLOWED - users
+    assert not stale, (
+        f"allowlisted modules no longer use host callbacks: {sorted(stale)} "
+        "— remove them so the host-only surface stays minimal"
+    )
+
+
+def test_telemetry_modules_exist_and_are_callback_free():
+    """The observability tentpole must stay axon-safe by construction."""
+    users = _scan()
+    for rel in ("monitors/telemetry.py", "core/instrument.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"
