@@ -25,7 +25,12 @@ from .core import (
     write_report_jsonl,
 )
 from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
-from .workflows import IslandWorkflow, StdWorkflow, run_host_pipelined
+from .workflows import (
+    IslandWorkflow,
+    StdWorkflow,
+    WorkflowCheckpointer,
+    run_host_pipelined,
+)
 
 __all__ = [
     "Algorithm",
@@ -43,6 +48,7 @@ __all__ = [
     "write_report_jsonl",
     "StdWorkflow",
     "IslandWorkflow",
+    "WorkflowCheckpointer",
     "run_host_pipelined",
     "algorithms",
     "core",

@@ -42,7 +42,9 @@ class TDEA(GAMOAlgorithm):
         new_cluster = jnp.concatenate(
             [jnp.ones((1,), bool), sorted_cluster[1:] != sorted_cluster[:-1]]
         )
-        pos_in_cluster = jnp.arange(n) - jnp.maximum.accumulate(
+        # lax.cummax, not jnp.maximum.accumulate: the ufunc .accumulate
+        # method does not exist on older jax (0.4.x PjitFunction)
+        pos_in_cluster = jnp.arange(n) - jax.lax.cummax(
             jnp.where(new_cluster, jnp.arange(n), 0)
         )
         theta_rank = jnp.zeros((n,), jnp.int32).at[order].set(pos_in_cluster)

@@ -11,20 +11,26 @@ also finds checkpoints left by a previous process) or
 ``evox_tpu.core.state_io.load(path, backend="pickle")`` — the saved object
 is the full ``StdWorkflowState`` pytree with numpy leaves, which drops
 straight back into ``wf.run``.
+
+Requires a callback-capable backend (NOT the tunneled axon TPU plugin):
+``init()`` probes and fails loudly there, pointing at the callback-free
+:class:`~evox_tpu.workflows.checkpoint.WorkflowCheckpointer`, which
+snapshots host-side between dispatches instead.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import warnings
 from pathlib import Path
-from typing import Any, List
+from typing import Any, List, Optional
 
 import jax
 from jax.experimental import io_callback
 
 from ..core.monitor import Monitor
-from .common import host0_sharding
+from .common import backend_supports_callbacks, host0_sharding
 
 
 class CheckpointMonitor(Monitor):
@@ -40,6 +46,22 @@ class CheckpointMonitor(Monitor):
         # adopt checkpoints from a previous process so crash-recovery and
         # keep-pruning see them
         self.saved: List[Path] = sorted(self.directory.glob("ckpt_????????"))
+
+    def init(self, key: Optional[jax.Array] = None):
+        # same loud-at-init contract as StepTimerMonitor: the in-step save
+        # rides io_callback, which the tunneled axon TPU backend cannot
+        # execute — fail here with a pointer at the callback-free path
+        # instead of hanging inside the runtime at the first save
+        if not backend_supports_callbacks():
+            raise RuntimeError(
+                "CheckpointMonitor saves from inside the jitted step via "
+                "io_callback, which this backend (axon-tunneled TPU) cannot "
+                "execute. Use workflows.checkpoint.WorkflowCheckpointer — "
+                "it snapshots host-side between dispatches (wf.run(..., "
+                "checkpointer=...) / run_host_pipelined(..., "
+                "checkpointer=...)) and is callback-free on every backend."
+            )
+        return None
 
     def hooks(self):
         return ("post_step",)
@@ -81,9 +103,20 @@ class CheckpointMonitor(Monitor):
                 pass
 
     def latest(self) -> Any:
-        """Load the newest checkpoint (None if nothing saved yet)."""
+        """Load the newest INTACT checkpoint (None if nothing usable).
+
+        A corrupt/torn snapshot (killed mid-write by a crash that predates
+        the atomic rename, disk trouble, partial copy) is skipped with a
+        warning and the next-older one is tried — restore never raises
+        mid-recovery because of one bad file."""
         self.flush()
-        if not self.saved:
-            return None
-        with open(self.saved[-1], "rb") as f:
-            return pickle.load(f)
+        for path in reversed(self.saved):
+            try:
+                with open(path, "rb") as f:
+                    return pickle.load(f)
+            except Exception as e:  # unpicklable/truncated/unreadable
+                warnings.warn(
+                    f"skipping corrupt checkpoint {path.name}: {e}",
+                    stacklevel=2,
+                )
+        return None

@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 from ...core.distributed import POP_AXIS
 from ...kernels.dominance import pack_dominator_rows, packed_dominance
 from ...utils.common import dominate_relation
+from ...utils.compat import shard_map
 
 INF = jnp.inf
 
@@ -233,7 +234,7 @@ def _non_dominated_sort_sharded(
     # check_vma=False: every output is derived from psum results (hence
     # genuinely replicated), but the device-varying dynamic_slice start
     # defeats the static replication analysis
-    rank, cut = jax.shard_map(
+    rank, cut = shard_map(
         island,
         mesh=mesh,
         in_specs=(P(axis_name), P()),

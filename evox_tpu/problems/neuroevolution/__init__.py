@@ -8,7 +8,7 @@ from .rollout import (
 from .policy import flat_mlp_policy, mlp_policy
 from .control import envs
 from .hostenv import HostEnvProblem, HostVectorEnv, NumpyCartPoleVec, envpool_make
-from .process_farm import ProcessRolloutFarm, spawn_local_workers
+from .process_farm import FarmDegradedError, ProcessRolloutFarm, spawn_local_workers
 from .rollout_farm import HostRolloutFarm
 from ._native import NativeVectorEnv, native_available
 
@@ -19,6 +19,7 @@ __all__ = [
     "NumpyCartPoleVec",
     "envpool_make",
     "HostRolloutFarm",
+    "FarmDegradedError",
     "ProcessRolloutFarm",
     "spawn_local_workers",
     "NativeVectorEnv",

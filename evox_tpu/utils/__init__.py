@@ -15,6 +15,7 @@ from .common import (
 from .aggregation import AggregationFunction
 from .io import to_x32_if_needed, x32_func_call
 from .optimizers import clipup, make_optimizer
+from . import compat
 
 __all__ = [
     "TreeAndVector",
@@ -34,4 +35,5 @@ __all__ = [
     "AggregationFunction",
     "clipup",
     "make_optimizer",
+    "compat",
 ]

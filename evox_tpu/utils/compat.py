@@ -1,0 +1,47 @@
+"""JAX version-compatibility shims.
+
+The repo targets whatever jax ships in the TPU container, but the public
+API surface has moved between releases. Everything version-dependent is
+resolved HERE, once, at import time — call sites stay on the modern
+spelling and older jax versions are adapted underneath:
+
+- ``shard_map``: promoted from ``jax.experimental.shard_map.shard_map``
+  to top-level ``jax.shard_map`` in modern jax; PRE-promotion versions
+  (the 0.4.x line, e.g. 0.4.37, where the bare ``jax.shard_map``
+  attribute raises ``AttributeError``) have only the experimental path.
+  The replication-check kwarg was also renamed ``check_rep`` ->
+  ``check_vma`` along the way. The shim accepts the modern ``check_vma``
+  name and translates to whatever the resolved implementation
+  understands (dropping it only if neither spelling exists).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _SHARD_MAP = jax.shard_map
+else:  # pre-promotion jax: the experimental path is the only one
+    from jax.experimental.shard_map import shard_map as _SHARD_MAP
+
+_SM_PARAMS = frozenset(inspect.signature(_SHARD_MAP).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kw: Any):
+    """``jax.shard_map`` across jax versions (modern keyword spelling).
+
+    ``check_vma`` follows the current jax name for the static
+    replication/varying-mesh-axes check; on jax versions whose
+    ``shard_map`` still calls it ``check_rep`` the value is passed
+    through under that name."""
+    if "check_vma" in _SM_PARAMS:
+        kw["check_vma"] = check_vma
+    elif "check_rep" in _SM_PARAMS:
+        kw["check_rep"] = check_vma
+    # else: a version without either spelling — nothing to forward
+    return _SHARD_MAP(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

@@ -1,3 +1,4 @@
+from .checkpoint import WorkflowCheckpointer
 from .std import StdWorkflow, StdWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
 from .pipelined import run_host_pipelined
@@ -7,5 +8,6 @@ __all__ = [
     "StdWorkflowState",
     "IslandWorkflow",
     "IslandWorkflowState",
+    "WorkflowCheckpointer",
     "run_host_pipelined",
 ]

@@ -73,6 +73,22 @@ def fused_run(wf: Any, state: Any, n_steps: int) -> Any:
     return state
 
 
+def quarantine_nonfinite(fitness: jax.Array) -> jax.Array:
+    """Replace non-finite fitness entries with the worst FINITE value of
+    the batch (internal minimization convention: the per-objective max),
+    so a poison candidate loses every comparison cleanly instead of
+    corrupting argmin/sorting/ranking — NaN propagates through every
+    comparison-based selection op. Multi-objective fitness is quarantined
+    per objective column. A column with NO finite entry falls back to the
+    dtype's max finite value. Jittable, shape-preserving."""
+    finite = jnp.isfinite(fitness)
+    worst = jnp.max(jnp.where(finite, fitness, -jnp.inf), axis=0)
+    worst = jnp.where(
+        jnp.isfinite(worst), worst, jnp.finfo(fitness.dtype).max
+    )
+    return jnp.where(finite, fitness, worst)
+
+
 def callback_evaluate(
     problem: Problem, pstate: Any, cand: Any, num_objectives: int = 1
 ) -> Tuple[jax.Array, Any]:

@@ -28,24 +28,60 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from .checkpoint import WorkflowCheckpointer, _as_checkpointer, resolve_resume
+
 
 def run_host_pipelined(
     wf,
     state,
     n_steps: int,
     on_generation: Optional[Callable[[int, Any, jax.Array], None]] = None,
+    checkpointer: Optional[WorkflowCheckpointer] = None,
+    resume_from: Any = None,
 ):
     """Run ``n_steps`` generations of ``wf`` (a :class:`StdWorkflow` whose
     problem is external/host-side), overlapping host evaluation with
     device dispatch and with ``on_generation(gen_index, state, fitness)``
     host work of the previous generation. Returns the final state —
     identical to ``for _ in range(n_steps): state = wf.step(state)``.
+
+    Crash safety: ``checkpointer=`` snapshots the state whenever
+    ``state.generation`` crosses a multiple of its cadence (host-side,
+    between dispatches — the next generation's evaluate is already in
+    flight while the snapshot pickles, and the final state is always
+    snapshotted). ``resume_from=`` (a
+    :class:`~evox_tpu.workflows.checkpoint.WorkflowCheckpointer` or a
+    directory) restores the newest intact snapshot and reinterprets
+    ``n_steps`` as the TOTAL generation target. Note the snapshot holds
+    only the workflow-state pytree: a host problem that draws
+    per-generation seeds from its own RNG (the rollout farms) re-seeds
+    fresh after a resume — resume bit-equivalence holds for host problems
+    whose evaluate is deterministic (see GUIDE.md §6).
     """
     if not wf.external:
         raise ValueError(
             "run_host_pipelined is for external (host) problems; jittable "
             "problems should use wf.run()'s fused device loop"
         )
+    if resume_from is not None:
+        state, n_steps = resolve_resume(resume_from, state, n_steps)
+        if checkpointer is None:
+            # a resumed run must stay crash-safe (and must record its own
+            # completion, or a second resume would re-run generations):
+            # default to checkpointing into the directory we resumed from,
+            # the same policy as StdWorkflow.resume()
+            checkpointer = _as_checkpointer(resume_from)
+    if n_steps <= 0:
+        # nothing left to run (e.g. resuming an already-complete run) —
+        # return BEFORE dispatching ask/eval: a stray background evaluate
+        # would waste a full generation and race the caller on the
+        # problem's sockets/state
+        return state
+    # on_generation receives the GLOBAL 0-based generation index (loop
+    # offset + the state's generation at entry), so logs and metric sinks
+    # stay consistent when a run is resumed mid-way instead of restarting
+    # from 0 (identical to the old loop index for fresh states)
+    gen0 = int(state.generation)
     eval_pool = ThreadPoolExecutor(max_workers=1)
     hook_pool = ThreadPoolExecutor(max_workers=1)
     try:
@@ -70,10 +106,20 @@ def run_host_pipelined(
                 # the eval thread blocks on cand materialization, not us
                 cand, ctx = wf.pipeline_ask(state)
                 fut = eval_pool.submit(wf.problem.evaluate, state.prob, cand)
+            if checkpointer is not None:
+                # between dispatches: the next eval is already in flight
+                # and the state is immutable, so the snapshot only costs
+                # the device->host copy at the checkpoint cadence
+                checkpointer.maybe_save(state)
             if on_generation is not None:
-                hook_fut = hook_pool.submit(on_generation, g, state, fitness)
+                hook_fut = hook_pool.submit(
+                    on_generation, gen0 + g, state, fitness
+                )
         if hook_fut is not None:
             hook_fut.result()
+        if checkpointer is not None:
+            if int(state.generation) % checkpointer.every != 0:
+                checkpointer.save(state)  # final state is always durable
         return state
     finally:
         eval_pool.shutdown(wait=False)

@@ -40,12 +40,19 @@ from ..core.distributed import (
     shard_pop,
 )
 from ..utils.common import parse_opt_direction
+from .checkpoint import (
+    WorkflowCheckpointer,
+    _as_checkpointer,
+    checkpointed_run,
+    resolve_resume,
+)
 from .common import (
     build_hook_table,
     callback_evaluate,
     finish_step,
     fused_run,
     make_run_loop,
+    quarantine_nonfinite,
     run_hooks,
 )
 
@@ -100,6 +107,14 @@ class StdWorkflow:
             too, std_workflow.py:189-193). Set True to accept the uneven
             layout anyway (GSPMD pads internally; shard_map mode still
             requires divisibility).
+        quarantine_nonfinite: replace NaN/±Inf fitness entries with the
+            worst FINITE value of their generation (per objective) after
+            the sign flip and before ``fit_transforms``/``tell`` — a
+            poison candidate then loses cleanly instead of corrupting
+            argmin/ranking (NaN poisons every comparison-based selection).
+            Monitors' ``post_eval`` (including TelemetryMonitor's NaN/Inf
+            counters) still observe the RAW fitness, so quarantined
+            candidates remain visible in telemetry.
     """
 
     def __init__(
@@ -117,6 +132,7 @@ class StdWorkflow:
         eval_shard_map: bool = False,
         allow_uneven_shards: bool = False,
         migrate_helper: Optional[Callable] = None,
+        quarantine_nonfinite: bool = False,
     ):
         self.algorithm = algorithm
         self.problem = problem
@@ -129,6 +145,7 @@ class StdWorkflow:
         self.external = (not problem.jittable) if external_problem is None else external_problem
         self.eval_shard_map = eval_shard_map
         self.migrate_helper = migrate_helper
+        self.quarantine_nonfinite = quarantine_nonfinite
         # migration stores raw (sign-flipped) fitness into the algorithm
         # state; population-relative shaped fitness cannot coexist with it
         # (the stored conventions would mix) — see Algorithm.migrate
@@ -195,7 +212,13 @@ class StdWorkflow:
     def step(self, state: StdWorkflowState) -> StdWorkflowState:
         return self._step(state)
 
-    def run(self, state: StdWorkflowState, n_steps: int) -> StdWorkflowState:
+    def run(
+        self,
+        state: StdWorkflowState,
+        n_steps: int,
+        checkpointer: Optional[WorkflowCheckpointer] = None,
+        resume_from: Any = None,
+    ) -> StdWorkflowState:
         """Run ``n_steps`` generations as ONE compiled program.
 
         TPU-first: a Python ``for`` loop over ``step`` pays a host dispatch
@@ -207,8 +230,53 @@ class StdWorkflow:
         the loop carry stays type-stable across the init_ask/init_tell
         dispatch). With ``jit_step=False`` this falls back to an eager
         Python loop for debugging.
+
+        Crash safety (axon-safe, no host callbacks — see
+        workflows/checkpoint.py): ``checkpointer=`` chunks the fused loop
+        at the checkpoint cadence and snapshots the state between
+        dispatches — final state identical to the unchunked run.
+        ``resume_from=`` (a :class:`WorkflowCheckpointer` or directory)
+        restores the newest intact snapshot first; ``n_steps`` then counts
+        TOTAL generations, so a crashed run re-invoked with identical
+        arguments completes the remaining generations and reproduces the
+        straight run's final state.
         """
+        if resume_from is not None:
+            state, n_steps = resolve_resume(resume_from, state, n_steps)
+            if checkpointer is None:
+                # a resumed run stays crash-safe and records its own
+                # completion (else a second resume would re-run
+                # generations): checkpoint into the resumed directory
+                checkpointer = _as_checkpointer(resume_from)
+        if checkpointer is not None:
+            return checkpointed_run(self, state, n_steps, checkpointer)
         return fused_run(self, state, n_steps)
+
+    def resume(
+        self,
+        checkpointer: WorkflowCheckpointer,
+        n_steps: int,
+        fallback_state: Optional[StdWorkflowState] = None,
+    ) -> StdWorkflowState:
+        """Continue an interrupted checkpointed run to ``n_steps`` TOTAL
+        generations: restore ``checkpointer``'s newest intact snapshot
+        (falling back to ``fallback_state`` — e.g. a fresh ``wf.init`` —
+        when no snapshot exists yet) and run the remaining generations
+        with checkpointing still on. ``resume()`` of an already-complete
+        run returns its final snapshot unchanged."""
+        state = checkpointer.latest()
+        if state is None:
+            if fallback_state is None:
+                raise FileNotFoundError(
+                    f"no usable checkpoint under {checkpointer.directory}; "
+                    "pass fallback_state=wf.init(key) to start fresh"
+                )
+            state = fallback_state
+        return self.run(
+            state,
+            max(n_steps - int(state.generation), 0),
+            checkpointer=checkpointer,
+        )
 
     def _dispatch_ask(self, state: StdWorkflowState) -> Tuple[bool, Any, Any]:
         """First-step-aware ask: ``(use_init, pop, astate)``. The single
@@ -318,10 +386,12 @@ class StdWorkflow:
             fit, new_ps = self.problem.evaluate(ps, c)
             return all_gather(fit), new_ps
 
+        from ..utils.compat import shard_map
+
         # check_vma=False: the gathered fitness and pass-through state ARE
         # replicated after the tiled all_gather, but the static analyzer
         # cannot prove it for arbitrary problem code
-        return jax.shard_map(
+        return shard_map(
             island,
             mesh=self.mesh,
             in_specs=(P(), P(_POP_AXIS_NAME)),
@@ -368,6 +438,8 @@ class StdWorkflow:
         fitness = shard_pop(fitness, self.mesh)
         self._run_hooks("post_eval", mstates, cand, fitness)
         fitness = self._flip(fitness)
+        if self.quarantine_nonfinite:
+            fitness = quarantine_nonfinite(fitness)
         for t in self.fit_transforms:
             fitness = t(fitness)
         self._run_hooks("pre_tell", mstates, fitness)
@@ -417,6 +489,11 @@ class StdWorkflow:
         self._run_hooks("post_eval", mstates, cand, fitness)
 
         fitness = self._flip(fitness)
+        if self.quarantine_nonfinite:
+            # poison (NaN/Inf) rows get the generation's worst-finite value
+            # AFTER monitors saw the raw fitness (telemetry still counts
+            # them) and BEFORE fit_transforms/tell (ranking stays sane)
+            fitness = quarantine_nonfinite(fitness)
         for t in self.fit_transforms:
             fitness = t(fitness)
         self._run_hooks("pre_tell", mstates, fitness)

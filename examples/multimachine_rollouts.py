@@ -55,8 +55,13 @@ def policy(params, obs):
 
 
 def main():
+    # the farm is self-healing (GUIDE.md §6 fault tolerance): a worker
+    # dying or hanging mid-generation has its slice re-rolled on a
+    # survivor (bit-identical fitness), request_timeout bounds every
+    # rollout, and replacement workers are re-admitted automatically
     farm = ProcessRolloutFarm(policy, CartPole, num_workers=2,
-                              cap_episode=200, host="127.0.0.1")
+                              cap_episode=200, host="127.0.0.1",
+                              min_workers=1, request_timeout=120.0)
     procs = spawn_local_workers(farm.address, 2)
     farm.bind()
     print(f"2 worker processes bound on {farm.address}")
@@ -67,9 +72,18 @@ def main():
     state = wf.init(jax.random.PRNGKey(0))
 
     # run_host_pipelined overlaps device ask/tell with the farm round-trip
-    # and the on_generation host work
+    # and the on_generation host work; checkpointer= makes the run
+    # crash-safe — after a crash, resume with
+    #   run_host_pipelined(wf, state, 10, resume_from=<printed dir>)
+    import tempfile
+
+    from evox_tpu import WorkflowCheckpointer
+
+    ckpt_dir = tempfile.mkdtemp(prefix="evox_tpu_ckpt_")
+    print(f"checkpointing to {ckpt_dir} (resume_from= this path)")
+    ckpt = WorkflowCheckpointer(ckpt_dir, every=5, keep=2)
     state = run_host_pipelined(
-        wf, state, 10,
+        wf, state, 10, checkpointer=ckpt,
         on_generation=lambda g, s, f:
             print(f"gen {g}: best episode return {float(jnp.max(f)):.0f}"),
     )

@@ -1,0 +1,171 @@
+"""Fault-injection harness for the self-healing evaluation stack.
+
+Module-level (hence picklable) chaos workers and flaky env wrappers that
+make every failure mode the farm/checkpointer must survive REPRODUCIBLE:
+
+- :func:`chaos_worker_main` — a farm worker that completes the normal
+  handshake/register/setup exchange, then misbehaves deterministically on
+  its first rollout request:
+
+  * ``"kill"``  — hard-exits mid-generation (``os._exit``), the closest
+    analog to an OOM-killed / preempted worker. The socket dies with it.
+  * ``"hang"``  — accepts the request and never answers (a wedged env or
+    a network partition); only the coordinator's ``request_timeout`` can
+    reclaim the slice.
+  * ``"drop"``  — closes the TCP connection cleanly without answering
+    (a crashed-but-flushed peer).
+  * ``"nan"``   — answers with NaN rewards of the right shape (a
+    numerically-poisoned simulator; exercises fitness quarantine rather
+    than farm recovery).
+
+  Modes fire ``after`` that many well-served rollout requests (default
+  0: misbehave on the very first), so tests can also exercise
+  late-generation failures.
+
+- :class:`NaNEnv` — gymnasium-API env wrapper whose reward turns NaN
+  after a step threshold, for in-process (HostRolloutFarm / workflow
+  quarantine) tests without any sockets.
+
+Everything here is deterministic — no random fault timing — so the
+chaos tests assert exact outcomes (bit-identical fitness, pytree
+equality) rather than "usually survives".
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Tuple
+
+import numpy as np
+
+from evox_tpu.problems.neuroevolution.process_farm import (
+    DEFAULT_AUTHKEY,
+    _handshake,
+    _recv,
+    _send,
+)
+
+from tests._farm_helpers import ScalarCartPole  # noqa: F401  (re-export)
+
+
+def chaos_worker_main(
+    address: Tuple[str, int],
+    authkey: bytes = DEFAULT_AUTHKEY,
+    mode: str = "kill",
+    after: int = 0,
+) -> None:
+    """A protocol-complete farm worker that injects one fault, see module
+    docstring for the modes. Serves pings and (for ``after > 0``) real
+    rollouts until the fault fires."""
+    import socket
+
+    import jax
+
+    from evox_tpu.problems.neuroevolution.rollout_farm import _Worker
+
+    sock = socket.create_connection(address)
+    try:
+        _handshake(sock, authkey, server=False)
+        _send(sock, {"type": "register"})
+        setup = _recv(sock)
+        assert setup["type"] == "setup", setup
+        worker = _Worker(setup["env_creator"], setup["mo_keys"])
+        policy = jax.jit(jax.vmap(setup["policy"]))
+        served = 0
+        while True:
+            try:
+                msg = _recv(sock)
+            except (ConnectionError, OSError):
+                return
+            if msg["type"] == "shutdown":
+                return
+            if msg["type"] == "ping":
+                _send(sock, {"type": "pong"})
+                continue
+            assert msg["type"] == "rollout", msg
+            if served < after:  # behave until the fault threshold
+                worker.rollout(policy, msg["subpop"], msg["seed"], msg["cap"])
+                rewards, mo, lengths = worker.results()
+                _send(
+                    sock,
+                    {
+                        "type": "result",
+                        "slice": msg.get("slice"),
+                        "rewards": rewards,
+                        "mo": mo,
+                        "lengths": lengths,
+                    },
+                )
+                served += 1
+                continue
+            # ------------------------------------------------ inject fault
+            if mode == "kill":
+                os._exit(1)  # mid-generation hard death, socket torn down
+            elif mode == "hang":
+                time.sleep(3600)  # wedged: only request_timeout reclaims us
+            elif mode == "drop":
+                sock.close()  # clean disconnect without a result
+                return
+            elif mode == "nan":
+                n = np.asarray(
+                    next(iter(jax.tree.leaves(msg["subpop"])))
+                ).shape[0]
+                _send(
+                    sock,
+                    {
+                        "type": "result",
+                        "slice": msg.get("slice"),
+                        "rewards": np.full((n,), np.nan),
+                        "mo": np.zeros((n, len(setup["mo_keys"]))),
+                        "lengths": np.ones((n,)),
+                    },
+                )
+                served += 1
+            else:
+                raise ValueError(f"unknown chaos mode: {mode!r}")
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def spawn_chaos_worker(
+    address: Tuple[str, int],
+    mode: str,
+    after: int = 0,
+    authkey: bytes = DEFAULT_AUTHKEY,
+):
+    """Start ONE chaos worker process (spawn context, daemonized)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(
+        target=chaos_worker_main,
+        args=(address, authkey, mode, after),
+        daemon=True,
+    )
+    p.start()
+    return p
+
+
+class NaNEnv:
+    """ScalarCartPole whose reward goes NaN after ``poison_after`` steps —
+    an in-process numerically-poisoned simulator for quarantine tests."""
+
+    def __init__(self, poison_after: int = 0, max_steps: int = 200):
+        self._base = ScalarCartPole(max_steps=max_steps)
+        self.poison_after = poison_after
+        self._steps = 0
+
+    def reset(self, seed=0):
+        self._steps = 0
+        return self._base.reset(seed)
+
+    def step(self, action):
+        obs, r, term, trunc, info = self._base.step(action)
+        self._steps += 1
+        if self._steps > self.poison_after:
+            r = float("nan")
+        return obs, r, term, trunc, info

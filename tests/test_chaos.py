@@ -1,0 +1,409 @@
+"""Chaos tests: the self-healing evaluation stack under injected faults.
+
+Every fault here is injected deterministically (tests/_chaos.py), so the
+assertions are exact: bit-identical fitness with and without a worker
+killed mid-generation, pytree-equal resume after a simulated driver
+crash, clean errors at the degradation floor. All farm interactions are
+timeout-bounded (small ``request_timeout`` / ``heartbeat_timeout``), so a
+hung worker can never wedge the suite.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu import StdWorkflow, WorkflowCheckpointer
+from evox_tpu.core.problem import Problem
+from evox_tpu.problems.neuroevolution.process_farm import (
+    FarmDegradedError,
+    ProcessRolloutFarm,
+    spawn_local_workers,
+)
+from evox_tpu.problems.neuroevolution.rollout_farm import HostRolloutFarm
+from evox_tpu.workflows.common import quarantine_nonfinite
+from evox_tpu.workflows.pipelined import run_host_pipelined
+
+from tests._chaos import spawn_chaos_worker
+from tests._farm_helpers import DIM, ScalarCartPole, flat_policy
+
+pytestmark = pytest.mark.chaos
+
+SEED = 1234
+
+
+def _mk_farm(num_workers, **kw):
+    kw.setdefault("request_timeout", 30.0)
+    kw.setdefault("heartbeat_timeout", 10.0)
+    kw.setdefault("retry_backoff", 0.01)
+    farm = ProcessRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=num_workers, cap_episode=40,
+        host="127.0.0.1", **kw,
+    )
+    farm._seed_rng = np.random.default_rng(SEED)
+    return farm
+
+
+def _reap(procs):
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.kill()
+
+
+def _wait_admitted(farm, n, timeout=90.0):
+    """Poll admit() until ``n`` workers are connected — a freshly spawned
+    worker pays several seconds of interpreter+jax import before it can
+    dial in, so fixed sleeps are a race."""
+    import time as _t
+
+    deadline = _t.monotonic() + timeout
+    while len(farm._conns) < n and _t.monotonic() < deadline:
+        farm.admit()
+        _t.sleep(0.2)
+    assert len(farm._conns) >= n, f"only {len(farm._conns)}/{n} workers joined"
+
+
+# ------------------------------------------------------------ worker death
+@pytest.mark.farm
+def test_fitness_identical_with_worker_killed_mid_generation():
+    """Acceptance: killing one worker mid-generation yields BIT-IDENTICAL
+    fitness to the failure-free run — the dead worker's slice (same
+    _tree_split slice, same seed + 7919*i seed) is re-rolled on the
+    survivor."""
+    pop = 0.5 * jax.random.normal(jax.random.PRNGKey(0), (10, DIM))
+
+    healthy = _mk_farm(2)
+    procs = spawn_local_workers(healthy.address, 2)
+    try:
+        healthy.bind(timeout=120.0)
+        f_healthy, _ = healthy.evaluate(healthy.init(), pop)
+    finally:
+        healthy.shutdown()
+        _reap(procs)
+
+    chaotic = _mk_farm(2)
+    procs = [spawn_chaos_worker(chaotic.address, mode="kill")]
+    procs += spawn_local_workers(chaotic.address, 1)
+    try:
+        chaotic.bind(timeout=120.0)
+        f_chaos, _ = chaotic.evaluate(chaotic.init(), pop)
+        # one worker hard-exited mid-generation, fitness must not notice
+        np.testing.assert_array_equal(np.asarray(f_chaos), np.asarray(f_healthy))
+        assert len(chaotic._conns) == 1  # the dead worker really was pruned
+    finally:
+        chaotic.shutdown()
+        _reap(procs)
+
+    # and both match the in-process reference farm (same slices/seed law)
+    local = HostRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=2, batch_policy=False,
+        cap_episode=40,
+    )
+    local._seed_rng = np.random.default_rng(SEED)
+    f_local, _ = local.evaluate(local.init(), pop)
+    np.testing.assert_allclose(
+        np.asarray(f_healthy), np.asarray(f_local), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.farm
+@pytest.mark.slow
+def test_hang_drop_and_readmission_sequence():
+    """One farm surviving a hung worker (request_timeout re-dispatch), a
+    clean-disconnect worker, and re-admitting a replacement, across
+    consecutive generations."""
+    pop = 0.3 * jax.random.normal(jax.random.PRNGKey(2), (8, DIM))
+    ref = HostRolloutFarm(
+        flat_policy, ScalarCartPole, num_workers=2, batch_policy=False,
+        cap_episode=40,
+    )
+    ref._seed_rng = np.random.default_rng(SEED)
+
+    farm = _mk_farm(2, request_timeout=4.0, heartbeat_timeout=4.0)
+    procs = [spawn_chaos_worker(farm.address, mode="hang")]
+    procs += spawn_local_workers(farm.address, 1)
+    try:
+        farm.bind(timeout=120.0)
+        # gen 1: the hung worker times out, its slice re-runs on the other
+        f1, _ = farm.evaluate(farm.init(), pop)
+        r1, _ = ref.evaluate(ref.init(), pop)
+        np.testing.assert_allclose(
+            np.asarray(f1), np.asarray(r1), rtol=1e-6, atol=1e-6
+        )
+        assert len(farm._conns) == 1
+        # gen 2: a clean-disconnect worker joins (re-admission), drops its
+        # slice without answering; the survivor still finishes
+        procs.append(spawn_chaos_worker(farm.address, mode="drop"))
+        _wait_admitted(farm, 2)
+        f2, _ = farm.evaluate(farm.init(), pop)
+        r2, _ = ref.evaluate(ref.init(), pop)
+        np.testing.assert_allclose(
+            np.asarray(f2), np.asarray(r2), rtol=1e-6, atol=1e-6
+        )
+        # gen 3: a healthy replacement is re-admitted with the cached
+        # setup payload and serves its slice normally
+        procs += spawn_local_workers(farm.address, 1)
+        _wait_admitted(farm, 2)
+        f3, _ = farm.evaluate(farm.init(), pop)
+        r3, _ = ref.evaluate(ref.init(), pop)
+        np.testing.assert_allclose(
+            np.asarray(f3), np.asarray(r3), rtol=1e-6, atol=1e-6
+        )
+        assert len(farm._conns) == 2
+    finally:
+        farm.shutdown()
+        _reap(procs)
+
+
+@pytest.mark.farm
+@pytest.mark.slow
+def test_farm_raises_cleanly_below_min_workers():
+    """Acceptance: survivors dropping below min_workers mid-generation
+    raises FarmDegradedError (not a hang, not a socket traceback), and a
+    respawned worker lets the SAME farm object finish the generation."""
+    pop = 0.3 * jax.random.normal(jax.random.PRNGKey(3), (8, DIM))
+    farm = _mk_farm(2, min_workers=2)
+    procs = [spawn_chaos_worker(farm.address, mode="kill")]
+    procs += spawn_local_workers(farm.address, 1)
+    try:
+        farm.bind(timeout=120.0)
+        with pytest.raises(FarmDegradedError, match="min_workers"):
+            farm.evaluate(farm.init(), pop)
+        # recovery: spawn a replacement; the next evaluate re-admits it
+        procs += spawn_local_workers(farm.address, 1)
+        _wait_admitted(farm, 2)
+        farm._seed_rng = np.random.default_rng(SEED)
+        fit, _ = farm.evaluate(farm.init(), pop)
+        ref = HostRolloutFarm(
+            flat_policy, ScalarCartPole, num_workers=2, batch_policy=False,
+            cap_episode=40,
+        )
+        ref._seed_rng = np.random.default_rng(SEED)
+        rfit, _ = ref.evaluate(ref.init(), pop)
+        np.testing.assert_allclose(
+            np.asarray(fit), np.asarray(rfit), rtol=1e-6, atol=1e-6
+        )
+    finally:
+        farm.shutdown()
+        _reap(procs)
+
+
+# --------------------------------------------------------- crash + resume
+def _tree_assert_allclose(a, b):
+    la, ta = jax.tree.flatten(a)
+    lb, tb = jax.tree.flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-7
+        )
+
+
+def test_crash_at_gen_k_resume_equals_straight_run(tmp_path):
+    """Acceptance: a 20-gen straight run and a run 'crashed' at gen 10 +
+    wf.resume() produce the same final state pytree on the 8-device CPU
+    mesh."""
+    from evox_tpu.algorithms.so.pso import PSO
+    from evox_tpu.core.distributed import create_mesh
+    from evox_tpu.problems.numerical import Sphere
+
+    mesh = create_mesh()
+
+    def mk_wf():
+        algo = PSO(
+            lb=jnp.full((6,), -5.0), ub=jnp.full((6,), 5.0), pop_size=16
+        )
+        return StdWorkflow(algo, Sphere(), mesh=mesh)
+
+    wf = mk_wf()
+    state0 = wf.init(jax.random.PRNGKey(7))
+    straight = wf.run(state0, 20)
+
+    ckpt = WorkflowCheckpointer(str(tmp_path / "ck"), every=5, keep=3)
+    wf_crashed = mk_wf()
+    mid = wf_crashed.run(state0, 10, checkpointer=ckpt)
+    assert int(mid.generation) == 10
+    del wf_crashed, mid  # "driver crash": nothing survives but the files
+
+    # fresh process analog: new workflow object, new checkpointer on the
+    # same directory, resume to the 20-gen TOTAL target
+    wf_resumed = mk_wf()
+    ckpt2 = WorkflowCheckpointer(str(tmp_path / "ck"), every=5, keep=3)
+    resumed = wf_resumed.resume(ckpt2, 20)
+    assert int(resumed.generation) == 20
+    _tree_assert_allclose(straight, resumed)
+
+    # resume of a COMPLETE run is a no-op returning the final snapshot
+    again = wf_resumed.resume(ckpt2, 20)
+    assert int(again.generation) == 20
+    _tree_assert_allclose(resumed, again)
+
+
+class _HostSphere(Problem):
+    """Deterministic host problem (numpy evaluate) — resume equivalence
+    for the pipelined driver needs determinism, not seeds."""
+
+    jittable = False
+
+    def fit_shape(self, pop_size):
+        return (pop_size,)
+
+    def evaluate(self, state, pop):
+        return np.sum(np.asarray(pop) ** 2, axis=1).astype(np.float32), state
+
+
+def test_pipelined_crash_resume_equivalence(tmp_path):
+    """run_host_pipelined: crash-at-gen-4 + resume_from= reproduces the
+    8-gen straight run for a deterministic host problem."""
+    from evox_tpu.algorithms.so.es import OpenES
+
+    def mk_wf():
+        algo = OpenES(
+            jnp.zeros(6), pop_size=8, learning_rate=0.1, noise_stdev=0.5
+        )
+        return StdWorkflow(algo, _HostSphere())
+
+    wf = mk_wf()
+    state0 = wf.init(jax.random.PRNGKey(11))
+    straight = run_host_pipelined(wf, state0, 8)
+
+    ckpt = WorkflowCheckpointer(str(tmp_path / "pk"), every=2, keep=2)
+    crashed = run_host_pipelined(mk_wf(), state0, 4, checkpointer=ckpt)
+    assert int(crashed.generation) == 4
+
+    resumed = run_host_pipelined(
+        mk_wf(), state0, 8, resume_from=str(tmp_path / "pk")
+    )
+    assert int(resumed.generation) == 8
+    _tree_assert_allclose(straight, resumed)
+    # the directory-string resume adopted the crashed run's every=2
+    # cadence (persisted in checkpointer.json) and kept checkpointing:
+    # a gen-6 snapshot exists (default every=10 would have skipped it)
+    names = [p.name for p in WorkflowCheckpointer(str(tmp_path / "pk")).snapshots()]
+    assert "ckpt_00000006.pkl" in names and "ckpt_00000008.pkl" in names
+
+    # resuming a COMPLETE run returns the snapshot without dispatching a
+    # stray background evaluate (n_steps reaches 0 before the pools start)
+    again = run_host_pipelined(
+        mk_wf(), state0, 8, resume_from=str(tmp_path / "pk")
+    )
+    assert int(again.generation) == 8
+    _tree_assert_allclose(resumed, again)
+
+
+def test_checkpointer_skips_corrupt_snapshots(tmp_path):
+    """latest() must fall back past torn/corrupt snapshots with a warning
+    — digest-validated manifests make corruption detectable."""
+    from evox_tpu.algorithms.so.pso import PSO
+    from evox_tpu.problems.numerical import Sphere
+
+    algo = PSO(lb=jnp.full((4,), -1.0), ub=jnp.full((4,), 1.0), pop_size=8)
+    wf = StdWorkflow(algo, Sphere())
+    state = wf.init(jax.random.PRNGKey(0))
+    ckpt = WorkflowCheckpointer(str(tmp_path), every=2, keep=5)
+    state = wf.run(state, 4, checkpointer=ckpt)
+    snaps = ckpt.snapshots()
+    assert len(snaps) >= 2
+
+    # tear the newest snapshot mid-write (truncate payload)
+    newest = snaps[-1]
+    newest.write_bytes(newest.read_bytes()[:10])
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        restored = ckpt.latest()
+    assert restored is not None
+    assert int(restored.generation) < int(state.generation)
+
+    # destroy every snapshot -> latest() is None, resume() needs fallback
+    for p in snaps:
+        p.write_bytes(b"junk")
+    with pytest.warns(UserWarning):
+        assert ckpt.latest() is None
+    with pytest.raises(FileNotFoundError, match="no usable checkpoint"):
+        wf.resume(ckpt, 4)
+
+
+# ------------------------------------------------------------- quarantine
+class _PoisonSphere(Problem):
+    """Jittable sphere whose first `n_poison` rows come back NaN and the
+    next one +Inf — deterministic fitness poison."""
+
+    jittable = True
+
+    def __init__(self, n_poison=2):
+        self.n_poison = n_poison
+
+    def evaluate(self, state, pop):
+        fit = jnp.sum(pop**2, axis=1)
+        fit = fit.at[: self.n_poison].set(jnp.nan)
+        fit = fit.at[self.n_poison].set(jnp.inf)
+        return fit, state
+
+
+def test_quarantine_nonfinite_helper():
+    f = jnp.asarray([1.0, jnp.nan, 3.0, -jnp.inf, 2.0])
+    q = quarantine_nonfinite(f)
+    np.testing.assert_array_equal(np.asarray(q), [1.0, 3.0, 3.0, 3.0, 2.0])
+    # per-objective columns; an all-poison column falls back to finfo max
+    f2 = jnp.asarray([[1.0, jnp.nan], [jnp.nan, jnp.nan], [0.5, jnp.nan]])
+    q2 = np.asarray(quarantine_nonfinite(f2))
+    np.testing.assert_array_equal(q2[:, 0], [1.0, 1.0, 0.5])
+    assert np.all(q2[:, 1] == np.finfo(np.float32).max)
+
+
+def test_workflow_quarantines_poison_fitness():
+    """With quarantine_nonfinite=True a poison problem cannot corrupt the
+    algorithm (OpenES's weighted-sum gradient otherwise turns the whole
+    center NaN from ONE poison row); TelemetryMonitor still counts the
+    raw NaN/Inf."""
+    from evox_tpu.algorithms.so.es import OpenES
+    from evox_tpu.monitors import TelemetryMonitor
+
+    def mk_algo():
+        return OpenES(
+            jnp.zeros(4), pop_size=8, learning_rate=0.1, noise_stdev=0.3
+        )
+
+    mon = TelemetryMonitor(capacity=8)
+    wf = StdWorkflow(
+        mk_algo(), _PoisonSphere(n_poison=2), monitors=[mon],
+        quarantine_nonfinite=True,
+    )
+    state = wf.init(jax.random.PRNGKey(5))
+    for _ in range(5):
+        state = wf.step(state)
+    mstate = state.monitors[0]
+    assert bool(jnp.isfinite(state.algo.center).all())
+    # telemetry saw the raw poison: 2 NaN + 1 Inf per generation
+    assert int(mstate.nan_fitness) == 2 * 5
+    assert int(mstate.inf_fitness) == 1 * 5
+
+    # without quarantine the same problem corrupts the center (contrast)
+    wf_raw = StdWorkflow(mk_algo(), _PoisonSphere(n_poison=2))
+    s = wf_raw.init(jax.random.PRNGKey(5))
+    for _ in range(2):
+        s = wf_raw.step(s)
+    assert not bool(jnp.isfinite(s.algo.center).all())
+
+
+def test_host_farm_nan_env_quarantined():
+    """NaNEnv (tests/_chaos.py) through the in-process HostRolloutFarm: a
+    numerically-poisoned simulator reaches the workflow as NaN fitness,
+    and quarantine keeps the ES update finite end to end."""
+    from evox_tpu.algorithms.so.es import OpenES
+    from tests._chaos import NaNEnv
+
+    farm = HostRolloutFarm(
+        flat_policy, lambda: NaNEnv(poison_after=0), num_workers=2,
+        batch_policy=False, cap_episode=20,
+    )
+    pop = 0.3 * jax.random.normal(jax.random.PRNGKey(1), (6, DIM))
+    fit, _ = farm.evaluate(farm.init(), pop)
+    assert not np.isfinite(np.asarray(fit)).any()  # the env really poisons
+
+    algo = OpenES(jnp.zeros(DIM), pop_size=6, learning_rate=0.1, noise_stdev=0.3)
+    wf = StdWorkflow(algo, farm, opt_direction="max", quarantine_nonfinite=True)
+    s = wf.init(jax.random.PRNGKey(2))
+    for _ in range(2):
+        s = wf.step(s)
+    assert bool(jnp.isfinite(s.algo.center).all())

@@ -322,6 +322,38 @@ def test_checkpoint_monitor_adopts_existing_and_validates(tmp_path):
     assert all(p.exists() for p in mon2.saved)
 
 
+def test_checkpoint_monitor_fails_loudly_without_callbacks(monkeypatch, tmp_path):
+    """Same contract as StepTimerMonitor: on a callback-less backend the
+    monitor must fail at init() with a pointer at the callback-free
+    WorkflowCheckpointer, not hang inside the runtime at the first save."""
+    import evox_tpu.monitors.checkpoint_monitor as cm
+
+    monkeypatch.setattr(cm, "backend_supports_callbacks", lambda: False)
+    mon = cm.CheckpointMonitor(str(tmp_path))
+    with pytest.raises(RuntimeError, match="WorkflowCheckpointer"):
+        mon.init()
+    # workflow init surfaces the same error (monitors init inside wf.init)
+    with pytest.raises(RuntimeError, match="axon"):
+        _workflow(monitors=(mon,)).init(jax.random.PRNGKey(0))
+
+
+def test_checkpoint_monitor_latest_skips_corrupt(tmp_path):
+    """latest() must warn and fall back past torn snapshots instead of
+    raising mid-restore."""
+    from evox_tpu.monitors import CheckpointMonitor
+
+    mon = CheckpointMonitor(str(tmp_path), every=1, keep=5)
+    mon._save(1, {"gen": 1})
+    mon._save(2, {"gen": 2})
+    mon.saved[-1].write_bytes(b"\x80torn")  # newest is torn
+    with pytest.warns(UserWarning, match="skipping corrupt checkpoint"):
+        obj = mon.latest()
+    assert obj == {"gen": 1}
+    mon.saved[0].write_bytes(b"")  # now everything is bad
+    with pytest.warns(UserWarning):
+        assert mon.latest() is None
+
+
 def test_async_orbax_save_roundtrip(tmp_path):
     """save(wait=False) stages and returns; wait_for_saves commits; load
     restores identically (and itself waits for pending saves)."""

@@ -159,6 +159,7 @@ def test_mo_migrate_elitist_selection():
     assert int(np.asarray(new.rank)[mig_row]) == 0
 
 
+@pytest.mark.slow
 def test_mo_islands_nsga2_zdt1():
     """Islands + NSGA-II on ZDT1: migration improves IGD over isolated
     islands at equal total evaluations, and the combined front converges."""

@@ -335,6 +335,7 @@ def test_fused_rollout_termination_accounting(make_soa, near_done_state):
     )
 
 
+@pytest.mark.slow
 def test_fused_engine_multichip_shard_map():
     """The fused engine runs per-shard under the explicit shard_map
     evaluation path AND under plain GSPMD mesh constraints; both match the

@@ -75,6 +75,7 @@ def test_fused_mlp_exact_vs_plane_loop(n, early_stop):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_mlp_episode_major_grid():
     n, ep = 12, 2
     penv, planes0 = _walker_setup(n, ep=ep, max_steps=3)
@@ -127,6 +128,7 @@ def test_planes_walker_matches_aos_walker():
         np.testing.assert_array_equal(np.asarray(d_pl[0]), np.asarray(d_aos))
 
 
+@pytest.mark.slow
 def test_fused_planes_engine_matches_scan_engine():
     """PolicyRolloutProblem(fused_planes=...) reproduces the standard
     early-exit engine's fitness on the walker with mlp_policy params."""
@@ -150,6 +152,7 @@ def test_fused_planes_engine_matches_scan_engine():
     )
 
 
+@pytest.mark.slow
 def test_fused_planes_multichip_shard_map():
     """The big-policy engine also runs per-shard under the shard_map
     evaluation path on a mesh, matching single-device."""
@@ -226,6 +229,7 @@ def test_fused_planes_rejects_wrong_policy():
         prob.evaluate(state, pop_tree)
 
 
+@pytest.mark.slow
 def test_fused_mlp_bf16_residency_close_to_f32():
     """weight_dtype=bfloat16 keeps VMEM-resident policy planes in bf16
     (f32 accumulate, f32 env math): totals stay close to the f32 run and
@@ -249,6 +253,7 @@ def test_fused_mlp_bf16_residency_close_to_f32():
     assert np.median(err / scale) < 0.1, (err / scale)
 
 
+@pytest.mark.slow
 def test_bf16_rollouts_train_walker():
     """Convergence with bf16-resident policies: OpenES on a small walker
     still improves the center policy's episode return (VERDICT r4 task 2

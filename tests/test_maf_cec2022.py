@@ -130,6 +130,7 @@ def test_cec2022_optimum_is_zero(i):
         assert float(f[0]) < 1e-2
 
 
+@pytest.mark.slow
 def test_cec2022_d20():
     X = jax.random.uniform(jax.random.PRNGKey(9), (4, 20)) * 200 - 100
     for i in range(1, 13):

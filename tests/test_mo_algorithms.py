@@ -125,6 +125,7 @@ def test_ibea_dtlz2_igd():
     assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
 
 
+@pytest.mark.slow
 def test_hype_dtlz2_igd():
     # MC scoring path (exact_hv_max_n=0): the r3-baseline convergence
     # contract, CI-cheap. The exact m=3 path has its own convergence
@@ -133,6 +134,7 @@ def test_hype_dtlz2_igd():
     assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
 
 
+@pytest.mark.slow
 def test_hype_exact_m3_dtlz2_igd():
     """Convergence with the EXACT m=3 per-front contributions (the
     default dispatch at this scale): smaller pop/gens keep the O(n^3)
@@ -162,6 +164,7 @@ def test_knea_adaptive_radius_updates():
     assert bool(jnp.any(state.algo.knee))
 
 
+@pytest.mark.slow
 def test_bceibea_dtlz2_igd():
     assert _igd_after(build(BCEIBEA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.2
 
@@ -181,6 +184,7 @@ def test_gde3_dtlz2_igd():
     assert _igd_after(build(GDE3, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.2
 
 
+@pytest.mark.slow
 def test_immoea_dtlz2_igd():
     assert _igd_after(build(IMMOEA, pop_size=100), DTLZ2(d=DIM, m=M), 100) < 0.25
 

@@ -8,7 +8,22 @@ import subprocess
 import sys
 import textwrap
 
+import jax
 import pytest
+
+# jaxlib < 0.5 CPU backend refuses cross-process collectives outright
+# ("Multiprocess computations aren't implemented on the CPU backend"),
+# so the DCN-emulation story is untestable on those versions — skip, not
+# fail: the capability gap is the RUNTIME's (jaxlib), not the code's,
+# hence the gate reads jaxlib's version, not jax's.
+import jaxlib
+
+_JAXLIB_VER = tuple(int(x) for x in jaxlib.__version__.split(".")[:2])
+pytestmark = pytest.mark.skipif(
+    _JAXLIB_VER < (0, 5),
+    reason="CPU backend cannot run multiprocess collectives on jaxlib "
+    f"{jaxlib.__version__} (needs >= 0.5)",
+)
 
 WORKER = textwrap.dedent(
     """
@@ -43,10 +58,20 @@ WORKER = textwrap.dedent(
     x = jnp.ones((4,)) * (pid + 1)
     def island(x):
         return D.all_gather(x, "pop")
+    # inline version shim (mirrors evox_tpu.utils.compat.shard_map — the
+    # package itself must not be imported here, see the loader note above):
+    # jax<0.4.35-ish only has the experimental path, and the replication
+    # check kwarg was renamed check_rep -> check_vma across versions
+    import inspect
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    sm_kw = {
+        ("check_vma" if "check_vma" in inspect.signature(sm).parameters
+         else "check_rep"): False
+    }
     y = jax.jit(
-        jax.shard_map(
-            island, mesh=mesh, in_specs=P("pop"), out_specs=P(), check_vma=False
-        )
+        sm(island, mesh=mesh, in_specs=P("pop"), out_specs=P(), **sm_kw)
     )(jax.make_array_from_process_local_data(NamedSharding(mesh, P("pop")), x))
     total = float(jnp.sum(y))
     expected = sum(4 * (i + 1) for i in range(nprocs)) * 1.0

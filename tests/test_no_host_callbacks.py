@@ -98,3 +98,19 @@ def test_telemetry_modules_exist_and_are_callback_free():
     for rel in ("monitors/telemetry.py", "core/instrument.py"):
         assert (PKG / rel).exists(), f"{rel} missing"
         assert rel not in users, f"{rel} must not use host callbacks"
+
+
+def test_fault_tolerance_modules_are_callback_free():
+    """The self-healing stack must work on the callback-less axon backend
+    by construction: WorkflowCheckpointer snapshots host-side between
+    dispatches, the process farm is pure host networking, and the compat
+    shim is pure import plumbing — none may grow a host callback."""
+    users = _scan()
+    for rel in (
+        "workflows/checkpoint.py",
+        "problems/neuroevolution/process_farm.py",
+        "problems/neuroevolution/rollout_farm.py",
+        "utils/compat.py",
+    ):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"

@@ -15,6 +15,8 @@ from evox_tpu.problems.neuroevolution.rollout_farm import HostRolloutFarm
 
 from tests._farm_helpers import DIM, ScalarCartPole, flat_policy
 
+pytestmark = pytest.mark.farm
+
 
 @pytest.fixture
 def farm():

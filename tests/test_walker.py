@@ -10,6 +10,7 @@ that policies actually train on it through the standard rollout problem.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from evox_tpu import StdWorkflow
 from evox_tpu.algorithms.so.es import OpenES
@@ -88,6 +89,7 @@ def test_rollout_problem_evaluates_population():
     assert len(np.unique(np.asarray(fit))) > 1  # policies differentiate
 
 
+@pytest.mark.slow
 def test_openes_improves_walker_fitness():
     """ES finds the survive-longer/forward-progress signal within a few
     generations — the env has a learnable gradient, not just noise."""

@@ -251,6 +251,7 @@ def test_sharded_mo_selection_matches_single_device():
     np.testing.assert_allclose(p_s, p_r, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sharded_selection_at_chunked_build_size():
     """Chunked-build x row-sharded interaction at engagement size
     (VERDICT r4 task 4): above merged n=20000 the REPLICATED path switches
@@ -391,6 +392,7 @@ def test_eval_monitor_mo_archive_inf_objective_rows():
     assert pf.shape[0] == int(ms.pf_count)
 
 
+@pytest.mark.slow
 def test_migrate_helper_injects_foreign_individuals():
     """Human-in-the-loop migration slot (reference std_workflow.py:230-244):
     a jittable helper feeds (do_migrate, pop, fit) and the algorithm's
