@@ -11,6 +11,8 @@ __version__ = "0.1.0"
 
 from .core import (
     Algorithm,
+    GuardedAlgorithm,
+    IPOPRestarts,
     Problem,
     Monitor,
     PyTreeNode,
@@ -34,6 +36,8 @@ from .workflows import (
 
 __all__ = [
     "Algorithm",
+    "GuardedAlgorithm",
+    "IPOPRestarts",
     "Problem",
     "Monitor",
     "PyTreeNode",

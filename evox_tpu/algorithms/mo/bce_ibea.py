@@ -26,7 +26,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
 from ...operators.selection.basic import tournament
@@ -97,16 +99,16 @@ def pc_selection(
 
 
 class BCEIBEAState(PyTreeNode):
-    population: jax.Array  # PC archive (the algorithm's output)
-    fitness: jax.Array
-    npc: jax.Array  # NPC (IBEA) population
-    npc_fit: jax.Array
-    new_pc: jax.Array  # PC-exploration offspring awaiting the even phase
-    new_pc_fit: jax.Array
-    n_nd: jax.Array
-    counter: jax.Array
-    offspring: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))  # PC archive (the algorithm's output)
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    npc: jax.Array = field(sharding=P(POP_AXIS))  # NPC (IBEA) population
+    npc_fit: jax.Array = field(sharding=P(POP_AXIS))
+    new_pc: jax.Array = field(sharding=P(POP_AXIS))  # PC-exploration offspring awaiting the even phase
+    new_pc_fit: jax.Array = field(sharding=P(POP_AXIS))
+    n_nd: jax.Array = field(sharding=P())
+    counter: jax.Array = field(sharding=P())
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class BCEIBEA(IBEA):

@@ -23,7 +23,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
 from ...operators.selection.non_dominate import non_dominate_indices
@@ -31,15 +33,15 @@ from .moead import MOEAD
 
 
 class EAGMOEADState(PyTreeNode):
-    population: jax.Array  # external archive (the algorithm's output)
-    fitness: jax.Array
-    inner_pop: jax.Array  # MOEA/D working population
-    inner_fit: jax.Array
-    success: jax.Array  # (LP, n) archive admissions per subproblem
-    offspring: jax.Array
-    offspring_loc: jax.Array  # (n,) subproblem each offspring came from
-    gen: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))  # external archive (the algorithm's output)
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    inner_pop: jax.Array = field(sharding=P(POP_AXIS))  # MOEA/D working population
+    inner_fit: jax.Array = field(sharding=P(POP_AXIS))
+    success: jax.Array = field(sharding=P())  # (LP, n) archive admissions per subproblem
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    offspring_loc: jax.Array = field(sharding=P(POP_AXIS))  # (n,) subproblem each offspring came from
+    gen: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class EAGMOEAD(MOEAD):

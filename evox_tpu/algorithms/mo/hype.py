@@ -28,6 +28,9 @@ import jax.numpy as jnp
 from ...metrics.hypervolume import hypervolume_contributions
 from ...operators.selection.basic import tournament_multifit
 from ...operators.selection.non_dominate import non_dominated_sort
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import field
 from .common import GAMOAlgorithm, MOState, uniform_init
 
 
@@ -90,8 +93,8 @@ def exact_contrib_2d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Arr
 
 
 class HypEState(MOState):
-    ref_point: jax.Array  # (m,) fixed sampling reference
-    rank: jax.Array  # (pop,) survivors' non-domination ranks (exact — every
+    ref_point: jax.Array = field(sharding=P())  # (m,) fixed sampling reference
+    rank: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) survivors' non-domination ranks (exact — every
     # dominator of a survivor is itself kept, so ranks are subset-invariant)
 
 

@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 
 from ...core.algorithm import Algorithm
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.gaussian_process import GPRegression
 from ...operators.mutation.ops import polynomial
 from ...operators.sampling.uniform import UniformSampling
@@ -28,10 +30,10 @@ from .common import uniform_init
 
 
 class IMMOEAState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    offspring: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class IMMOEA(Algorithm):

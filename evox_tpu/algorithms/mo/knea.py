@@ -27,15 +27,18 @@ import jax.numpy as jnp
 from ...operators.selection.basic import tournament_multifit
 from ...operators.selection.non_dominate import non_dominated_sort
 from ...utils.common import pairwise_euclidean_dist
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import field
 from .common import GAMOAlgorithm, MOState, uniform_init
 
 
 class KnEAState(MOState):
-    knee: jax.Array  # (pop,) bool
-    rank: jax.Array  # (pop,) survivors' non-domination ranks (exact: every
+    knee: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) bool
+    rank: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) survivors' non-domination ranks (exact: every
     # dominator of a survivor is itself kept, so ranks are subset-invariant)
-    r: jax.Array  # () adaptive radius factor
-    t: jax.Array  # () knee ratio of the last processed front
+    r: jax.Array = field(sharding=P())  # () adaptive radius factor
+    t: jax.Array = field(sharding=P())  # () knee ratio of the last processed front
 
 
 def weighted_neighbor_dist(fit: jax.Array, k: int) -> jax.Array:

@@ -11,7 +11,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.mutation.ops import polynomial
 from ...operators.sampling.uniform import UniformSampling
 from .common import uniform_init
@@ -21,13 +23,13 @@ from .sra import _sde_density
 
 
 class LMOCSOState(PyTreeNode):
-    population: jax.Array
-    velocity: jax.Array
-    fitness: jax.Array
-    offspring: jax.Array
-    off_velocity: jax.Array
-    gen: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    velocity: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    offspring: jax.Array = field(sharding=P())
+    off_velocity: jax.Array = field(sharding=P())
+    gen: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class LMOCSO(Algorithm):

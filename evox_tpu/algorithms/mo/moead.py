@@ -19,7 +19,9 @@ import jax
 import jax.numpy as jnp
 
 from ...core.algorithm import Algorithm
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
 from ...operators.sampling.uniform import UniformSampling
@@ -29,11 +31,11 @@ from .common import uniform_init
 
 
 class MOEADState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    ideal: jax.Array
-    offspring: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    ideal: jax.Array = field(sharding=P())
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class MOEAD(Algorithm):

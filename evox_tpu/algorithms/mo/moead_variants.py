@@ -20,7 +20,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.crossover.sbx import simulated_binary
 from ...operators.mutation.ops import polynomial
 from ...operators.sampling.uniform import UniformSampling
@@ -31,14 +33,14 @@ from ...core.algorithm import Algorithm
 
 
 class MOEADDRAState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    ideal: jax.Array
-    utility: jax.Array
-    old_value: jax.Array  # aggregation value per subproblem at last update
-    offspring: jax.Array
-    gen: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    ideal: jax.Array = field(sharding=P())
+    utility: jax.Array = field(sharding=P(POP_AXIS))
+    old_value: jax.Array = field(sharding=P(POP_AXIS))  # aggregation value per subproblem at last update
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    gen: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class MOEADDRA(MOEAD):
@@ -118,10 +120,10 @@ class MOEADDRA(MOEAD):
 
 
 class MOEADM2MState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    offspring: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class MOEADM2M(Algorithm):

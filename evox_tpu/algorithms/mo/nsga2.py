@@ -22,12 +22,15 @@ from ...operators.selection.non_dominate import (
     rank_crowding_truncate,
 )
 from ...operators.selection.basic import tournament_multifit
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import field
 from .common import GAMOAlgorithm, MOState
 
 
 class NSGA2State(MOState):
-    rank: jax.Array  # survivors' Pareto rank from the last selection
-    crowd: jax.Array  # survivors' crowding distance from the last selection
+    rank: jax.Array = field(sharding=P(POP_AXIS))  # survivors' Pareto rank from the last selection
+    crowd: jax.Array = field(sharding=P(POP_AXIS))  # survivors' crowding distance from the last selection
 
 
 class NSGA2(GAMOAlgorithm):

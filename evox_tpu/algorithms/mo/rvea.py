@@ -10,7 +10,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ...core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ...core.distributed import POP_AXIS
+from ...core.struct import PyTreeNode, field
 from ...operators.sampling.uniform import UniformSampling
 from ...operators.selection.rvea_selection import (
     ref_vec_guided,
@@ -20,12 +22,12 @@ from .common import GAMOAlgorithm, MOState, uniform_init
 
 
 class RVEAState(PyTreeNode):
-    population: jax.Array
-    fitness: jax.Array
-    vectors: jax.Array
-    offspring: jax.Array
-    gen: jax.Array
-    key: jax.Array
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    vectors: jax.Array = field(sharding=P(POP_AXIS))
+    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    gen: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class RVEA(GAMOAlgorithm):

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 from .de import select_rand_indices
 
 # [F, CR] parameter pool (Wang et al. 2011, §III)
@@ -33,7 +34,14 @@ class CoDEState(PyTreeNode):
 
 
 class CoDE(Algorithm):
-    def __init__(self, lb, ub, pop_size: int):
+    def __init__(
+        self,
+        lb,
+        ub,
+        pop_size: int,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
+    ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -81,7 +89,12 @@ class CoDE(Algorithm):
         t1 = jnp.where(mask1, v1, pop)
         t2 = jnp.where(mask2, v2, pop)
         t3 = v3  # current-to-rand/1 uses no crossover
-        trials = jnp.clip(jnp.concatenate([t1, t2, t3], axis=0), self.lb, self.ub)
+        trials = sanitize_bounds(
+            jnp.concatenate([t1, t2, t3], axis=0),
+            self.lb,
+            self.ub,
+            self.bound_handling,
+        )
         return trials, state.replace(trials=trials, key=key)
 
     def tell(self, state: CoDEState, fitness: jax.Array) -> CoDEState:

@@ -18,6 +18,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 def select_rand_indices(key: jax.Array, pop_size: int, n: int) -> jax.Array:
@@ -49,8 +50,10 @@ class DE(Algorithm):
         num_difference_vectors: int = 1,
         differential_weight: float = 0.5,
         cross_probability: float = 0.9,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
         assert base_vector in ("rand", "best")
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -96,7 +99,9 @@ class DE(Algorithm):
         r = jax.random.uniform(k_cr, (self.pop_size, self.dim))
         j_rand = jax.random.randint(k_j, (self.pop_size, 1), 0, self.dim)
         mask = (r < self.CR) | (jnp.arange(self.dim) == j_rand)
-        return jnp.clip(jnp.where(mask, mutant, pop), self.lb, self.ub)
+        return sanitize_bounds(
+            jnp.where(mask, mutant, pop), self.lb, self.ub, self.bound_handling
+        )
 
     def ask(self, state: DEState) -> Tuple[jax.Array, DEState]:
         key, k = jax.random.split(state.key)

@@ -18,6 +18,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 from .de import select_rand_indices
 
 
@@ -43,7 +44,9 @@ class JaDE(Algorithm):
         p_best: float = 0.05,
         c: float = 0.1,
         use_archive: bool = True,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -103,7 +106,9 @@ class JaDE(Algorithm):
         r = jax.random.uniform(kcr, (n, d))
         j_rand = jax.random.randint(kj, (n, 1), 0, d)
         mask = (r < CR[:, None]) | (jnp.arange(d) == j_rand)
-        trials = jnp.clip(jnp.where(mask, mutant, pop), self.lb, self.ub)
+        trials = sanitize_bounds(
+            jnp.where(mask, mutant, pop), self.lb, self.ub, self.bound_handling
+        )
         return trials, state.replace(trials=trials, F=F, CR=CR, key=key)
 
     def tell(self, state: JaDEState, fitness: jax.Array) -> JaDEState:

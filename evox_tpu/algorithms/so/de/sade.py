@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 from .de import select_rand_indices
 
 _N_STRATEGY = 4
@@ -39,7 +40,15 @@ class SaDEState(PyTreeNode):
 
 
 class SaDE(Algorithm):
-    def __init__(self, lb, ub, pop_size: int, learning_period: int = 50):
+    def __init__(
+        self,
+        lb,
+        ub,
+        pop_size: int,
+        learning_period: int = 50,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
+    ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -105,7 +114,7 @@ class SaDE(Algorithm):
         trials = jnp.take_along_axis(
             candidates, strategy[None, :, None], axis=0
         ).squeeze(0)
-        trials = jnp.clip(trials, self.lb, self.ub)
+        trials = sanitize_bounds(trials, self.lb, self.ub, self.bound_handling)
         return trials, state.replace(
             trials=trials, strategy=strategy, CR=CR[:, 0], key=key
         )

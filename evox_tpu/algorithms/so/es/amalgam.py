@@ -18,18 +18,21 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
+from .common import clamp_step_size
 
 
 class AMaLGaMState(PyTreeNode):
-    mean: jax.Array
-    C: jax.Array  # covariance (full) or variance vector (independent)
-    mean_shift: jax.Array
-    c_mult: jax.Array
-    best_fitness: jax.Array
-    no_improvement: jax.Array
-    population: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    C: jax.Array = field(sharding=P())  # covariance (full) or variance vector (independent)
+    mean_shift: jax.Array = field(sharding=P())
+    c_mult: jax.Array = field(sharding=P())
+    best_fitness: jax.Array = field(sharding=P())
+    no_improvement: jax.Array = field(sharding=P())
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class _AMaLGaMBase(Algorithm):
@@ -96,6 +99,7 @@ class _AMaLGaMBase(Algorithm):
         if self.full_cov:
             C_hat = centered.T @ centered / self.n_elite
             C = (1 - self.eta_shift) * state.C + self.eta_shift * C_hat
+            C = (C + C.T) / 2.0  # keep Cholesky's symmetry assumption exact
         else:
             C_hat = jnp.mean(centered**2, axis=0)
             C = (1 - self.eta_shift) * state.C + self.eta_shift * C_hat
@@ -118,7 +122,10 @@ class _AMaLGaMBase(Algorithm):
             mean=mean,
             C=C,
             mean_shift=mean_shift,
-            c_mult=jnp.maximum(c_mult, 1e-10),
+            # rails on the multiplicative distribution multiplier: the SDR
+            # rule can only shrink/grow geometrically, so 0/inf are
+            # absorbing states (es/common.py clamp_step_size rationale)
+            c_mult=clamp_step_size(c_mult, 1e-10, 1e10),
             best_fitness=jnp.minimum(best, state.best_fitness),
             no_improvement=no_improvement,
         )

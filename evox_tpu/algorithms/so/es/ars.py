@@ -12,13 +12,14 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.struct import PyTreeNode, field
 
 
 class ARSState(PyTreeNode):
-    center: jax.Array
-    delta: jax.Array
-    key: jax.Array
+    center: jax.Array = field(sharding=P())
+    delta: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class ARS(Algorithm):

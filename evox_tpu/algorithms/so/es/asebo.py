@@ -16,18 +16,19 @@ import jax.numpy as jnp
 import optax
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.struct import PyTreeNode, field
 from .common import make_optimizer
 
 
 class ASEBOState(PyTreeNode):
-    center: jax.Array
-    grad_archive: jax.Array  # (k, dim), decayed
-    alpha: jax.Array  # isotropic mixture weight in [0, 1]
-    opt_state: tuple
-    noise: jax.Array
-    iteration: jax.Array
-    key: jax.Array
+    center: jax.Array = field(sharding=P())
+    grad_archive: jax.Array = field(sharding=P())  # (k, dim), decayed
+    alpha: jax.Array = field(sharding=P())  # isotropic mixture weight in [0, 1]
+    opt_state: tuple = field(sharding=P())
+    noise: jax.Array = field(sharding=P())
+    iteration: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class ASEBO(Algorithm):

@@ -30,7 +30,10 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
+from .common import clamp_step_size, safe_eigh
 
 
 def _default_pop_size(dim: int) -> int:
@@ -38,16 +41,16 @@ def _default_pop_size(dim: int) -> int:
 
 
 class CMAESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    pc: jax.Array
-    ps: jax.Array
-    C: jax.Array
-    B: jax.Array
-    D: jax.Array
-    z: jax.Array  # standardized samples of the current generation
-    iteration: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    pc: jax.Array = field(sharding=P())
+    ps: jax.Array = field(sharding=P())
+    C: jax.Array = field(sharding=P())
+    B: jax.Array = field(sharding=P())
+    D: jax.Array = field(sharding=P())
+    z: jax.Array = field(sharding=P(POP_AXIS))  # standardized samples of the current generation
+    iteration: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class CMAES(Algorithm):
@@ -59,8 +62,17 @@ class CMAES(Algorithm):
         recombination_weights=None,
         cm: float = 1.0,
         decomp_per_iter: Optional[int] = None,
+        sigma_floor: float = 1e-20,
+        sigma_ceiling: float = 1e20,
+        cond_cap: float = 1e14,
     ):
         assert init_stdev > 0
+        # numeric guards (es/common.py): identity for healthy trajectories,
+        # rails for multiplicative sigma collapse/explosion and for a
+        # drifted/indefinite covariance reaching eigh
+        self.sigma_floor = sigma_floor
+        self.sigma_ceiling = sigma_ceiling
+        self.cond_cap = cond_cap
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
@@ -144,7 +156,11 @@ class CMAES(Algorithm):
             * (jnp.outer(pc, pc) + (1 - hsig) * self.cc * (2 - self.cc) * state.C)
             + self.cmu * rank_mu
         )
-        sigma = state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1))
+        sigma = clamp_step_size(
+            state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1)),
+            self.sigma_floor,
+            self.sigma_ceiling,
+        )
 
         B, D = jax.lax.cond(
             it % self.decomp_per_iter == 0,
@@ -155,31 +171,36 @@ class CMAES(Algorithm):
             mean=mean, sigma=sigma, pc=pc, ps=ps, C=C, B=B, D=D, iteration=it,
         )
 
-    @staticmethod
-    def _decompose(C: jax.Array):
-        C = (C + C.T) / 2.0
-        eigvals, B = jnp.linalg.eigh(C)
-        D = jnp.sqrt(jnp.maximum(eigvals, 1e-20))
-        return B, D
+    def _decompose(self, C: jax.Array):
+        return safe_eigh(C, self.cond_cap)
 
 
 class SepCMAESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    pc: jax.Array
-    ps: jax.Array
-    C: jax.Array  # diagonal of the covariance
-    z: jax.Array
-    iteration: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    pc: jax.Array = field(sharding=P())
+    ps: jax.Array = field(sharding=P())
+    C: jax.Array = field(sharding=P())  # diagonal of the covariance
+    z: jax.Array = field(sharding=P(POP_AXIS))
+    iteration: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class SepCMAES(Algorithm):
     """Separable (diagonal-covariance) CMA-ES — O(d) memory, for very high
     dimension (Ros & Hansen 2008). Reference cma_es.py:200-253."""
 
-    def __init__(self, center_init, init_stdev: float, pop_size: Optional[int] = None):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        sigma_floor: float = 1e-20,
+        sigma_ceiling: float = 1e20,
+    ):
         assert init_stdev > 0
+        self.sigma_floor = sigma_floor
+        self.sigma_ceiling = sigma_ceiling
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
@@ -249,7 +270,11 @@ class SepCMAES(Algorithm):
             + self.cmu * rank_mu
         )
         C = jnp.maximum(C, 1e-20)
-        sigma = state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1))
+        sigma = clamp_step_size(
+            state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1)),
+            self.sigma_floor,
+            self.sigma_ceiling,
+        )
         return state.replace(mean=mean, sigma=sigma, pc=pc, ps=ps, C=C, iteration=it)
 
 

@@ -2,6 +2,56 @@
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from ....utils.optimizers import make_optimizer  # re-exported for ES modules
 
-__all__ = ["make_optimizer"]
+__all__ = ["make_optimizer", "clamp_step_size", "safe_eigh"]
+
+
+def clamp_step_size(
+    sigma: jax.Array, floor: float = 1e-20, ceiling: float = 1e20
+) -> jax.Array:
+    """Clamp an ES step size into ``[floor, ceiling]``.
+
+    Value-identical to the unguarded update whenever sigma is in range
+    (``jnp.clip`` is the identity there), so healthy trajectories are
+    unchanged; a multiplicatively collapsing/exploding sigma is pinned at
+    the rail instead of reaching 0/inf and silently destroying the run
+    (0 * z freezes sampling; inf poisons the whole state). NaN passes
+    through — arithmetic cannot repair it; that is GuardedAlgorithm's
+    job (core/guardrail.py)."""
+    return jnp.clip(sigma, floor, ceiling)
+
+
+def safe_eigh(C: jax.Array, cond_cap: float = 1e14):
+    """``eigh`` of a covariance with condition-number capping and a
+    non-finite fallback.
+
+    Returns ``(B, D)`` with ``B`` the eigenvector matrix and ``D`` the
+    per-axis standard deviations (sqrt of the clamped eigenvalues):
+
+    - eigenvalues are clamped into ``[max_eig / cond_cap, max_eig]`` —
+      a drifted/indefinite covariance (tiny negative eigenvalues are
+      routine fp noise at convergence) yields a usable factorization
+      whose condition number is bounded, instead of a zero/imaginary
+      axis. For any covariance with condition below ``cond_cap`` the
+      clamp is the identity, so healthy runs are unchanged (the previous
+      behavior floored at an absolute 1e-20, which at f32 precision was
+      reachable only by already-degenerate matrices).
+    - if ``eigh`` itself produces non-finite output (a NaN-poisoned C —
+      LAPACK/XLA may return NaN or garbage), fall back to the identity
+      basis with unit scales so sampling stays finite while the
+      state-level guard (core/guardrail.py) triggers the real recovery.
+    """
+    n = C.shape[0]
+    C = (C + C.T) / 2.0
+    eigvals, B = jnp.linalg.eigh(C)
+    max_eig = jnp.maximum(jnp.max(eigvals), 1e-20)
+    D = jnp.sqrt(jnp.clip(eigvals, max_eig / cond_cap, max_eig))
+    ok = jnp.all(jnp.isfinite(B)) & jnp.all(jnp.isfinite(D))
+    return (
+        jnp.where(ok, B, jnp.eye(n, dtype=C.dtype)),
+        jnp.where(ok, D, jnp.ones((n,), dtype=C.dtype)),
+    )

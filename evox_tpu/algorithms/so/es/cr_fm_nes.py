@@ -19,18 +19,21 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
+from .common import clamp_step_size
 from .nes import nes_utilities
 
 
 class CRFMNESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    D: jax.Array
-    v: jax.Array
-    ps: jax.Array
-    z: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    D: jax.Array = field(sharding=P())
+    v: jax.Array = field(sharding=P())
+    ps: jax.Array = field(sharding=P())
+    z: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class CR_FM_NES(Algorithm):
@@ -39,7 +42,11 @@ class CR_FM_NES(Algorithm):
         center_init,
         init_stdev: float,
         pop_size: Optional[int] = None,
+        sigma_floor: float = 1e-20,
+        sigma_ceiling: float = 1e20,
     ):
+        self.sigma_floor = sigma_floor
+        self.sigma_ceiling = sigma_ceiling
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = d = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
@@ -98,13 +105,20 @@ class CR_FM_NES(Algorithm):
         ps = (1 - self.cs) * state.ps + math.sqrt(
             self.cs * (2 - self.cs)
         ) * self.me_sqrt * (u @ z)
-        sigma = state.sigma * jnp.exp(
-            self.cs / 2.0 * (jnp.sum(ps**2) / self.dim - 1.0)
+        sigma = clamp_step_size(
+            state.sigma * jnp.exp(self.cs / 2.0 * (jnp.sum(ps**2) / self.dim - 1.0)),
+            self.sigma_floor,
+            self.sigma_ceiling,
         )
         # rank-one direction: decay toward the weighted step (path-style)
         v_new = (1 - self.lr_v) * v + self.lr_v * y_w
         vn = jnp.linalg.norm(v_new)
         v_new = jnp.where(vn > 2.0, v_new * (2.0 / vn), v_new)  # keep conditioning
         # diagonal scale: SNES-style exponential multiplicative update
-        D = state.D * jnp.exp(self.lr_D / 2.0 * (u @ (z**2 - 1.0)))
+        # the diagonal scale is multiplicative like sigma: same rails
+        D = clamp_step_size(
+            state.D * jnp.exp(self.lr_D / 2.0 * (u @ (z**2 - 1.0))),
+            self.sigma_floor,
+            self.sigma_ceiling,
+        )
         return state.replace(mean=mean, sigma=sigma, D=D, v=v_new, ps=ps)

@@ -16,15 +16,16 @@ import jax.numpy as jnp
 import optax
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.struct import PyTreeNode, field
 from .common import make_optimizer
 
 
 class ESMCState(PyTreeNode):
-    center: jax.Array
-    opt_state: tuple
-    noise: jax.Array
-    key: jax.Array
+    center: jax.Array = field(sharding=P())
+    opt_state: tuple = field(sharding=P())
+    noise: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class ESMC(Algorithm):

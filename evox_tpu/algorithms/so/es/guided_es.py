@@ -18,16 +18,17 @@ import jax.numpy as jnp
 import optax
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.struct import PyTreeNode, field
 from .common import make_optimizer
 
 
 class GuidedESState(PyTreeNode):
-    center: jax.Array
-    grad_subspace: jax.Array  # (k, dim) recent gradient archive
-    opt_state: tuple
-    noise: jax.Array
-    key: jax.Array
+    center: jax.Array = field(sharding=P())
+    grad_subspace: jax.Array = field(sharding=P())  # (k, dim) recent gradient archive
+    opt_state: tuple = field(sharding=P())
+    noise: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class GuidedES(Algorithm):

@@ -24,7 +24,9 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 
 # hard dependency of this module only — the package __init__ catches the
 # ImportError so the rest of the ES family works without flax
@@ -60,12 +62,12 @@ class _LrModulator(nn.Module):
 
 
 class LESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    path_mean: jax.Array  # momentum-style evolution paths (3 timescales)
-    path_sigma: jax.Array
-    population: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    path_mean: jax.Array = field(sharding=P())  # momentum-style evolution paths (3 timescales)
+    path_sigma: jax.Array = field(sharding=P())
+    population: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class LES(Algorithm):

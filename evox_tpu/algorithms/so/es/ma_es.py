@@ -18,21 +18,33 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
+from .common import clamp_step_size
 from .cma_es import _default_pop_size
 
 
 class MAESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    ps: jax.Array
-    M: jax.Array
-    z: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    ps: jax.Array = field(sharding=P())
+    M: jax.Array = field(sharding=P())
+    z: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class MAES(Algorithm):
-    def __init__(self, center_init, init_stdev: float, pop_size: Optional[int] = None):
+    def __init__(
+        self,
+        center_init,
+        init_stdev: float,
+        pop_size: Optional[int] = None,
+        sigma_floor: float = 1e-20,
+        sigma_ceiling: float = 1e20,
+    ):
+        self.sigma_floor = sigma_floor
+        self.sigma_ceiling = sigma_ceiling
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = n = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
@@ -82,20 +94,23 @@ class MAES(Algorithm):
             + self.c1 / 2 * (jnp.outer(ps, ps) - I)
             + self.cmu / 2 * (zz - I)
         )
-        sigma = state.sigma * jnp.exp(
-            self.cs / self.damps * (jnp.linalg.norm(ps) / self.chiN - 1)
+        sigma = clamp_step_size(
+            state.sigma
+            * jnp.exp(self.cs / self.damps * (jnp.linalg.norm(ps) / self.chiN - 1)),
+            self.sigma_floor,
+            self.sigma_ceiling,
         )
         return state.replace(mean=mean, sigma=sigma, ps=ps, M=M)
 
 
 class LMMAESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    ps: jax.Array
-    M: jax.Array  # (m, dim) direction vectors
-    z: jax.Array
-    iteration: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    ps: jax.Array = field(sharding=P())
+    M: jax.Array = field(sharding=P())  # (m, dim) direction vectors
+    z: jax.Array = field(sharding=P(POP_AXIS))
+    iteration: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class LMMAES(Algorithm):
@@ -105,7 +120,11 @@ class LMMAES(Algorithm):
         init_stdev: float,
         pop_size: Optional[int] = None,
         memory_size: Optional[int] = None,
+        sigma_floor: float = 1e-20,
+        sigma_ceiling: float = 1e20,
     ):
+        self.sigma_floor = sigma_floor
+        self.sigma_ceiling = sigma_ceiling
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = n = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
@@ -168,8 +187,10 @@ class LMMAES(Algorithm):
         M = (1 - self.cc[:, None]) * state.M + jnp.sqrt(
             self.mueff * self.cc * (2 - self.cc)
         )[:, None] * z_w[None, :]
-        sigma = state.sigma * jnp.exp(
-            (cs / 2.0) * (jnp.sum(ps**2) / self.dim - 1.0)
+        sigma = clamp_step_size(
+            state.sigma * jnp.exp((cs / 2.0) * (jnp.sum(ps**2) / self.dim - 1.0)),
+            self.sigma_floor,
+            self.sigma_ceiling,
         )
         return state.replace(
             mean=mean, sigma=sigma, ps=ps, M=M, iteration=state.iteration + 1

@@ -14,7 +14,9 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
 
 
 def nes_utilities(pop_size: int) -> jax.Array:
@@ -26,11 +28,11 @@ def nes_utilities(pop_size: int) -> jax.Array:
 
 
 class XNESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    B: jax.Array  # normalized shape matrix; full transform A = sigma * B
-    z: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())
+    B: jax.Array = field(sharding=P())  # normalized shape matrix; full transform A = sigma * B
+    z: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class XNES(Algorithm):
@@ -90,10 +92,10 @@ def _expm_sym(M: jax.Array) -> jax.Array:
 
 
 class SeparableNESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array  # per-dimension stdev
-    z: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=P())
+    sigma: jax.Array = field(sharding=P())  # per-dimension stdev
+    z: jax.Array = field(sharding=P(POP_AXIS))
+    key: jax.Array = field(sharding=P())
 
 
 class SeparableNES(Algorithm):

@@ -23,17 +23,18 @@ import jax.numpy as jnp
 import optax
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ....core.struct import PyTreeNode, field
 from .common import make_optimizer
 
 
 class PersistentESState(PyTreeNode):
-    center: jax.Array
-    pert_accum: jax.Array  # (n_pairs, dim) accumulated perturbations
-    opt_state: tuple
-    noise: jax.Array
-    inner_step: jax.Array
-    key: jax.Array
+    center: jax.Array = field(sharding=P())
+    pert_accum: jax.Array = field(sharding=P())  # (n_pairs, dim) accumulated perturbations
+    opt_state: tuple = field(sharding=P())
+    noise: jax.Array = field(sharding=P())
+    inner_step: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class PersistentES(Algorithm):
@@ -94,11 +95,11 @@ class PersistentES(Algorithm):
 
 
 class NoiseReuseESState(PyTreeNode):
-    center: jax.Array
-    noise: jax.Array
-    opt_state: tuple
-    inner_step: jax.Array
-    key: jax.Array
+    center: jax.Array = field(sharding=P())
+    noise: jax.Array = field(sharding=P())
+    opt_state: tuple = field(sharding=P())
+    inner_step: jax.Array = field(sharding=P())
+    key: jax.Array = field(sharding=P())
 
 
 class NoiseReuseES(Algorithm):

@@ -15,21 +15,25 @@ import jax
 import jax.numpy as jnp
 
 from ....core.algorithm import Algorithm
-from ....core.struct import PyTreeNode
+# aliased _PS, not the usual P: this state has a field named P
+from jax.sharding import PartitionSpec as _PS
+from ....core.distributed import POP_AXIS
+from ....core.struct import PyTreeNode, field
+from .common import clamp_step_size
 from .cma_es import _default_pop_size
 
 
 class RMESState(PyTreeNode):
-    mean: jax.Array
-    sigma: jax.Array
-    pc: jax.Array
-    P: jax.Array  # (m, dim) stored evolution paths
-    p_iters: jax.Array  # (m,) generation each path was stored
-    prev_fitness: jax.Array
-    s: jax.Array  # smoothed success measure
-    iteration: jax.Array
-    z: jax.Array
-    key: jax.Array
+    mean: jax.Array = field(sharding=_PS())
+    sigma: jax.Array = field(sharding=_PS())
+    pc: jax.Array = field(sharding=_PS())
+    P: jax.Array = field(sharding=_PS())  # (m, dim) stored evolution paths
+    p_iters: jax.Array = field(sharding=_PS())  # (m,) generation each path was stored
+    prev_fitness: jax.Array = field(sharding=_PS())
+    s: jax.Array = field(sharding=_PS())  # smoothed success measure
+    iteration: jax.Array = field(sharding=_PS())
+    z: jax.Array = field(sharding=_PS(POP_AXIS))
+    key: jax.Array = field(sharding=_PS())
 
 
 class RMES(Algorithm):
@@ -39,7 +43,11 @@ class RMES(Algorithm):
         init_stdev: float,
         pop_size: Optional[int] = None,
         memory_size: int = 2,
+        sigma_floor: float = 1e-20,
+        sigma_ceiling: float = 1e20,
     ):
+        self.sigma_floor = sigma_floor
+        self.sigma_ceiling = sigma_ceiling
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = n = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
@@ -112,7 +120,11 @@ class RMES(Algorithm):
         ranks = jnp.argsort(jnp.argsort(merged)).astype(jnp.float32)
         q = (jnp.mean(ranks[self.mu :]) - jnp.mean(ranks[: self.mu])) / self.mu
         s = (1 - self.c_sigma) * state.s + self.c_sigma * (q - self.q_star)
-        sigma = state.sigma * jnp.exp(s / self.d_sigma)
+        sigma = clamp_step_size(
+            state.sigma * jnp.exp(s / self.d_sigma),
+            self.sigma_floor,
+            self.sigma_ceiling,
+        )
 
         return state.replace(
             mean=mean, sigma=sigma, pc=pc, P=P, p_iters=p_iters,

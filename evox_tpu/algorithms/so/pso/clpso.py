@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class CLPSOState(PyTreeNode):
@@ -35,7 +36,9 @@ class CLPSO(Algorithm):
         pop_size: int,
         inertia_weight: float = 0.7298,
         const_coefficient: float = 1.49445,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -86,7 +89,9 @@ class CLPSO(Algorithm):
         r = jax.random.uniform(k_r, (n, d))
         v = self.w * state.velocity + self.c * r * (exemplar - state.population)
         v = jnp.clip(v, -self.vmax, self.vmax)
-        pop = jnp.clip(state.population + v, self.lb, self.ub)
+        pop = sanitize_bounds(
+            state.population + v, self.lb, self.ub, self.bound_handling
+        )
         return pop, state.replace(population=pop, velocity=v, key=key)
 
     def tell(self, state: CLPSOState, fitness: jax.Array) -> CLPSOState:

@@ -49,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class CSOState(PyTreeNode):
@@ -66,7 +67,15 @@ class CSOState(PyTreeNode):
 
 
 class CSO(Algorithm):
-    def __init__(self, lb, ub, pop_size: int, phi: float = 0.0):
+    def __init__(
+        self,
+        lb,
+        ub,
+        pop_size: int,
+        phi: float = 0.0,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
+    ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         assert pop_size % 2 == 0, "CSO needs an even population size"
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
@@ -125,7 +134,9 @@ class CSO(Algorithm):
         r2 = jax.random.uniform(k2, (half, self.dim))
         r3 = jax.random.uniform(k3, (half, self.dim))
         new_v = r1 * v_s + r2 * (x_w - x_s) + self.phi * r3 * (center - x_s)
-        candidates = jnp.clip(x_s + new_v, self.lb, self.ub)
+        candidates = sanitize_bounds(
+            x_s + new_v, self.lb, self.ub, self.bound_handling
+        )
         return x_w, v_w, f_w, candidates, new_v
 
     def ask(self, state: CSOState) -> Tuple[jax.Array, CSOState]:

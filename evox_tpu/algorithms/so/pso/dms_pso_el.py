@@ -20,6 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class DMSPSOELState(PyTreeNode):
@@ -46,7 +47,9 @@ class DMSPSOEL(Algorithm):
         c_pbest: float = 1.49445,
         c_lbest: float = 1.49445,
         c_gbest: float = 1.49445,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         assert pop_size % sub_swarm_size == 0
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
@@ -124,7 +127,9 @@ class DMSPSOEL(Algorithm):
         )
         v = jnp.where(state.gen < self.phase_switch, dynamic_v, followed_v)
         v = jnp.clip(v, -self.vmax, self.vmax)
-        pop = jnp.clip(state.population + v, self.lb, self.ub)
+        pop = sanitize_bounds(
+            state.population + v, self.lb, self.ub, self.bound_handling
+        )
         return pop, state.replace(
             population=pop, velocity=v, gen=state.gen + 1, key=key
         )

@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 from .topology import full_neighbours, ring_neighbours, square_neighbours
 
 
@@ -36,7 +37,9 @@ class FIPS(Algorithm):
         pop_size: int,
         topology: str = "ring",  # "ring" | "square" | "full"
         phi: float = 4.1,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -81,7 +84,9 @@ class FIPS(Algorithm):
         nbr_pbest = state.pbest[self.neighbours]  # (n, k, d)
         social = jnp.sum(r * (nbr_pbest - state.population[:, None, :]), axis=1)
         v = self.chi * (state.velocity + social)
-        pop = jnp.clip(state.population + v, self.lb, self.ub)
+        pop = sanitize_bounds(
+            state.population + v, self.lb, self.ub, self.bound_handling
+        )
         return pop, state.replace(population=pop, velocity=v, key=key)
 
     def tell(self, state: FIPSState, fitness: jax.Array) -> FIPSState:

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class FSPSOState(PyTreeNode):
@@ -40,7 +41,9 @@ class FSPSO(Algorithm):
         cognitive_coefficient: float = 1.49445,
         social_coefficient: float = 1.49445,
         mutate_rate: float = 0.01,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.dim = dim
         self.pop_size = pop_size
         self.lb = jnp.zeros((dim,), dtype=jnp.float32)
@@ -89,7 +92,7 @@ class FSPSO(Algorithm):
         # bit-flip style mutation in the continuous relaxation
         mutate = jax.random.bernoulli(km, self.mutate_rate, (n, d))
         pop = jnp.where(mutate, jax.random.uniform(kmv, (n, d)), pop)
-        pop = jnp.clip(pop, self.lb, self.ub)
+        pop = sanitize_bounds(pop, self.lb, self.ub, self.bound_handling)
         return pop, state.replace(population=pop, velocity=v, key=key)
 
     def tell(self, state: FSPSOState, fitness: jax.Array) -> FSPSOState:

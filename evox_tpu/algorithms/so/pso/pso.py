@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class PSOState(PyTreeNode):
@@ -41,7 +42,9 @@ class PSO(Algorithm):
         social_coef: float = 0.8,
         mean: Optional[jax.Array] = None,
         stdev: Optional[jax.Array] = None,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = self.lb.shape[0]
@@ -92,7 +95,9 @@ class PSO(Algorithm):
             + self.phi_p * rp * (pbest_position - state.population)
             + self.phi_g * rg * (gbest_position[None, :] - state.population)
         )
-        population = jnp.clip(state.population + velocity, self.lb, self.ub)
+        population = sanitize_bounds(
+            state.population + velocity, self.lb, self.ub, self.bound_handling
+        )
         return state.replace(
             population=population,
             velocity=velocity,

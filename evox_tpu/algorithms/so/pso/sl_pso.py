@@ -18,6 +18,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class SLPSOState(PyTreeNode):
@@ -35,7 +36,9 @@ class _SLPSOBase(Algorithm):
         pop_size: int,
         social_influence_factor: float = 0.01,  # epsilon ~ dim/pop * beta
         demonstrator_choice_factor: float = 0.7,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -85,7 +88,9 @@ class _SLPSOBase(Algorithm):
         # the swarm best does not move (no demonstrator better than itself)
         is_best = (rank_of == 0)[:, None]
         v = jnp.where(is_best, 0.0, v)
-        pop = jnp.clip(state.population + v, self.lb, self.ub)
+        pop = sanitize_bounds(
+            state.population + v, self.lb, self.ub, self.bound_handling
+        )
         return pop, state.replace(population=pop, velocity=v, key=key)
 
     def tell(self, state: SLPSOState, fitness: jax.Array) -> SLPSOState:

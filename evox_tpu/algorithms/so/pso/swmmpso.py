@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 from ....core.algorithm import Algorithm
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
+from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 from .topology import mutate_shortcuts, neighbour_best, ring_neighbours
 
 
@@ -64,7 +65,9 @@ class SwmmPSO(Algorithm):
         shortcut_p: float = 0.0,
         mean: Optional[jax.Array] = None,
         stdev: Optional[float] = None,
+        bound_handling: str = "clip",  # operators/sanitize.py, static
     ):
+        self.bound_handling = validate_bound_handling(bound_handling)
         self.lb = jnp.asarray(lb, dtype=jnp.float32)
         self.ub = jnp.asarray(ub, dtype=jnp.float32)
         self.dim = int(self.lb.shape[0])
@@ -141,7 +144,9 @@ class SwmmPSO(Algorithm):
             + phi1 * (pbest - state.population)
             + phi2 * (nbest - state.population)
         )
-        pop = jnp.clip(state.population + v, self.lb, self.ub)
+        pop = sanitize_bounds(
+            state.population + v, self.lb, self.ub, self.bound_handling
+        )
         return state.replace(
             population=pop,
             velocity=v,

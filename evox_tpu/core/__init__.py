@@ -22,9 +22,19 @@ from .instrument import (
     run_report,
     write_report_jsonl,
 )
+from .guardrail import (
+    GuardedAlgorithm,
+    GuardedState,
+    IPOPRestarts,
+    recenter_state,
+)
 from . import state_io
 
 __all__ = [
+    "GuardedAlgorithm",
+    "GuardedState",
+    "IPOPRestarts",
+    "recenter_state",
     "DispatchRecorder",
     "instrument",
     "run_report",

@@ -241,6 +241,13 @@ def run_report(
                 entry["monitor_index"] = i
                 telemetry.append(entry)
         report["telemetry"] = telemetry
+        # guarded runs (core/guardrail.py): surface the wrapper's health
+        # counters as a first-class section (duck-typed — core stays
+        # decoupled from the concrete GuardedAlgorithm class)
+        algo = getattr(workflow, "algorithm", None)
+        astate = getattr(state, "algo", None)
+        if hasattr(algo, "health_report") and hasattr(astate, "restarts"):
+            report["guardrail"] = algo.health_report(astate)
     if recorder is not None:
         report["dispatch"] = recorder.summary()
     if extra:

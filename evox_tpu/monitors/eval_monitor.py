@@ -19,8 +19,9 @@ import jax.numpy as jnp
 from jax.experimental import io_callback
 
 from ..core.monitor import Monitor
+from jax.sharding import PartitionSpec as P
 from .common import backend_supports_callbacks, host0_sharding
-from ..core.struct import PyTreeNode
+from ..core.struct import PyTreeNode, field
 from ..operators.selection.non_dominate import (
     crowding_distance,
     non_dominated_sort,
@@ -28,14 +29,16 @@ from ..operators.selection.non_dominate import (
 
 
 class EvalMonitorState(PyTreeNode):
-    topk_fitness: Optional[jax.Array]  # (k,) or (cap, m) raw user-direction
-    topk_solution: Optional[Any]
-    pf_count: Optional[jax.Array]
+    # layout annotations are all P(): every buffer here is capacity- or
+    # k-leading (elite/archive/ring), never population-leading
+    topk_fitness: Optional[jax.Array] = field(sharding=P())  # (k,) or (cap, m) raw user-direction
+    topk_solution: Optional[Any] = field(sharding=P())
+    pf_count: Optional[jax.Array] = field(sharding=P())
     # device-side generation-history ring buffer (history_capacity > 0):
-    hist_fit: Optional[jax.Array] = None  # (K, width[, m]) inf-padded
-    hist_sol: Optional[Any] = None  # (K, width, ...) when history_solutions
-    hist_len: Optional[jax.Array] = None  # (K,) int32 valid rows per slot
-    hist_count: Optional[jax.Array] = None  # () int32 total generations seen
+    hist_fit: Optional[jax.Array] = field(sharding=P(), default=None)  # (K, width[, m]) inf-padded
+    hist_sol: Optional[Any] = field(sharding=P(), default=None)  # (K, width, ...) when history_solutions
+    hist_len: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) int32 valid rows per slot
+    hist_count: Optional[jax.Array] = field(sharding=P(), default=None)  # () int32 total generations seen
 
 
 # Backward-compat alias: the probe now lives in monitors/common.py so every

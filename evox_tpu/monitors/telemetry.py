@@ -32,25 +32,31 @@ import numpy as np
 
 from ..core.instrument import sanitize_json
 from ..core.monitor import Monitor
-from ..core.struct import PyTreeNode
+from jax.sharding import PartitionSpec as P
+from ..core.struct import PyTreeNode, field
 
 
 class TelemetryState(PyTreeNode):
     # cumulative counters (int32: documented bound, ~2.1e9 events)
-    generations: jax.Array  # () generations observed
-    evals: jax.Array  # () candidate evaluations observed
-    nan_candidates: jax.Array  # () NaN elements across candidate leaves
-    inf_candidates: jax.Array  # () Inf elements across candidate leaves
-    nan_fitness: jax.Array  # () NaN fitness elements
-    inf_fitness: jax.Array  # () Inf fitness elements
+    generations: jax.Array = field(sharding=P())  # () generations observed
+    evals: jax.Array = field(sharding=P())  # () candidate evaluations observed
+    nan_candidates: jax.Array = field(sharding=P())  # () NaN elements across candidate leaves
+    inf_candidates: jax.Array = field(sharding=P())  # () Inf elements across candidate leaves
+    nan_fitness: jax.Array = field(sharding=P())  # () NaN fitness elements
+    inf_fitness: jax.Array = field(sharding=P())  # () Inf fitness elements
     # best-so-far tracking, internal minimization convention
-    best_key: jax.Array  # () or (m,): per-objective ideal point for MO
-    best_generation: jax.Array  # () 1-based generation of last improvement
-    stagnation: jax.Array  # () generations since best improved
+    best_key: jax.Array = field(sharding=P())  # () or (m,): per-objective ideal point for MO
+    best_generation: jax.Array = field(sharding=P())  # () 1-based generation of last improvement
+    stagnation: jax.Array = field(sharding=P())  # () generations since best improved
     # per-generation rings, slot = (generation - 1) % capacity
-    ring_best: jax.Array  # (K,) or (K, m), USER fitness convention
-    ring_mean: jax.Array  # (K,) or (K, m), finite-masked mean
-    ring_diversity: jax.Array  # (K,) mean per-dim std of the candidates
+    ring_best: jax.Array = field(sharding=P())  # (K,) or (K, m), USER fitness convention
+    ring_mean: jax.Array = field(sharding=P())  # (K,) or (K, m), finite-masked mean
+    ring_diversity: jax.Array = field(sharding=P())  # (K,) mean per-dim std of the candidates
+    # guardrail mirror (core/guardrail.py): cumulative on-device restarts
+    # and the latest trigger bitmask of a GuardedAlgorithm driving this
+    # run; stays 0 for unguarded algorithms (picked up in post_step)
+    restarts: jax.Array = field(sharding=P())
+    last_trigger: jax.Array = field(sharding=P())
 
 
 class TelemetryMonitor(Monitor):
@@ -86,7 +92,7 @@ class TelemetryMonitor(Monitor):
         self.opt_direction = jnp.ones((1,), dtype=jnp.float32)
 
     def hooks(self):
-        return ("post_eval",)
+        return ("post_eval", "post_step")
 
     def init(self, key: Optional[jax.Array] = None) -> TelemetryState:
         K, m = self.capacity, self.num_objectives
@@ -106,6 +112,8 @@ class TelemetryMonitor(Monitor):
             ring_best=jnp.full(ring_shape, jnp.inf, dtype=jnp.float32),
             ring_mean=jnp.full(ring_shape, jnp.inf, dtype=jnp.float32),
             ring_diversity=jnp.full((K,), jnp.inf, dtype=jnp.float32),
+            restarts=i32(),
+            last_trigger=i32(),
         )
 
     # ------------------------------------------------------------------ hook
@@ -201,7 +209,23 @@ class TelemetryMonitor(Monitor):
             ring_best=upd(mstate.ring_best, gen_best_key * direction),
             ring_mean=upd(mstate.ring_mean, gen_mean),
             ring_diversity=upd(mstate.ring_diversity, diversity),
+            restarts=mstate.restarts,  # owned by post_step (guardrail mirror)
+            last_trigger=mstate.last_trigger,
         )
+
+    def post_step(self, mstate: TelemetryState, wf_state: Any) -> TelemetryState:
+        """Mirror a GuardedAlgorithm's health counters (restart count and
+        latest trigger bitmask) into the telemetry state, so they reach
+        ``report()``/``run_report()`` without the caller touching the
+        algorithm state. Structural (trace-time) detection: unguarded
+        workflows compile this hook to a no-op."""
+        astate = getattr(wf_state, "algo", None)
+        if hasattr(astate, "restarts") and hasattr(astate, "last_trigger"):
+            return mstate.replace(
+                restarts=jnp.asarray(astate.restarts, jnp.int32),
+                last_trigger=jnp.asarray(astate.last_trigger, jnp.int32),
+            )
+        return mstate
 
     # --------------------------------------------------------------- getters
     def get_best_fitness(self, mstate: TelemetryState) -> jax.Array:
@@ -251,6 +275,8 @@ class TelemetryMonitor(Monitor):
             "inf_candidates": int(mstate.inf_candidates),
             "nan_fitness": int(mstate.nan_fitness),
             "inf_fitness": int(mstate.inf_fitness),
+            "restarts": int(mstate.restarts),
+            "last_trigger": int(mstate.last_trigger),
             "capacity": self.capacity,
             "num_objectives": self.num_objectives,
             "trajectory": self.get_trajectory(mstate),

@@ -1,3 +1,14 @@
-from . import selection, crossover, mutation, sampling, gaussian_process
+from . import selection, crossover, mutation, sampling, gaussian_process, sanitize
+from .sanitize import sanitize_bounds, validate_bound_handling, BOUND_METHODS
 
-__all__ = ["selection", "crossover", "mutation", "sampling", "gaussian_process"]
+__all__ = [
+    "selection",
+    "crossover",
+    "mutation",
+    "sampling",
+    "gaussian_process",
+    "sanitize",
+    "sanitize_bounds",
+    "validate_bound_handling",
+    "BOUND_METHODS",
+]

@@ -1,0 +1,71 @@
+"""Bound sanitization for candidate batches (clip / reflect / wrap).
+
+DE and PSO variants historically hard-coded ``jnp.clip`` onto the box
+bounds. Clipping is the cheapest repair but piles probability mass onto
+the faces of the box — a known diversity killer when the optimum sits on
+(or outside) a bound. This module is the single shared repair point: the
+method is chosen STATICALLY (a string hyperparameter, so every choice
+jits to straight-line math with no branching) and every consumer
+advertises it as a ``bound_handling=`` constructor argument.
+
+Methods (all shape-preserving, jittable):
+
+- ``"clip"``    — project onto the box. Bit-identical to the historical
+  ``jnp.clip`` behavior, including for non-finite inputs.
+- ``"reflect"`` — mirror the overshoot back into the box (repeated
+  reflection via triangle-wave folding, exact for any overshoot size).
+- ``"wrap"``    — periodic (toroidal) wrap-around via modulo.
+
+Non-finite elements are deliberately NOT repaired: a NaN candidate is a
+symptom of a deeper fault (exploded velocity, poisoned state) and must
+stay visible to the observability layer — TelemetryMonitor's
+``nan_candidates`` counter, ``quarantine_nonfinite``, and
+``GuardedAlgorithm``'s state checks are the designed handling path.
+Silently rewriting poison into a legitimate-looking in-bounds point would
+let it win selection while every counter reads clean. Under ``clip`` a
+non-finite value passes through unchanged; under ``reflect``/``wrap`` the
+modulo arithmetic degrades ±inf to NaN — still loudly non-finite.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sanitize_bounds", "validate_bound_handling", "BOUND_METHODS"]
+
+BOUND_METHODS = ("clip", "reflect", "wrap")
+
+
+def validate_bound_handling(method: str) -> str:
+    """Fail-fast constructor-time validation; returns ``method``.
+
+    The one shared definition of the error every ``bound_handling=``
+    consumer raises (DE/PSO families call this in ``__init__`` so a typo
+    surfaces at construction, not at first trace)."""
+    if method not in BOUND_METHODS:
+        raise ValueError(
+            f"unknown bound_handling {method!r}; choose from {BOUND_METHODS}"
+        )
+    return method
+
+
+def sanitize_bounds(
+    x: jax.Array, lb: jax.Array, ub: jax.Array, method: str = "clip"
+) -> jax.Array:
+    """Repair ``x`` into the box ``[lb, ub]`` with the given method.
+
+    ``method`` is static: the traced computation contains only the
+    selected repair. Non-finite elements propagate (see module
+    docstring — poison must stay visible)."""
+    validate_bound_handling(method)
+    if method == "clip":
+        return jnp.clip(x, lb, ub)
+    span = ub - lb
+    if method == "wrap":
+        return lb + jnp.where(span > 0, (x - lb) % jnp.where(span > 0, span, 1.0), 0.0)
+    # reflect: fold onto a 2*span triangle wave, then mirror the upper half
+    t = jnp.where(
+        span > 0, (x - lb) % jnp.where(span > 0, 2.0 * span, 1.0), 0.0
+    )
+    return lb + jnp.where(t > span, 2.0 * span - t, t)

@@ -1,0 +1,169 @@
+"""Host-boundary IPOP: increasing-population restarts between dispatches.
+
+:class:`~evox_tpu.core.guardrail.GuardedAlgorithm` detects degeneracy and
+restarts ON DEVICE — but with the SAME population size, because XLA shapes
+are static. The other half of the classic IPOP recipe (Auger & Hansen
+2005: each restart doubles λ, buying global exploration with the budget
+the failed basin wasted) requires new shapes, i.e. a new compiled
+program. This module implements that half at the host boundary:
+``StdWorkflow.run(restarts=policy)`` and ``run_host_pipelined(...,
+restarts=policy)`` chunk the run at ``policy.check_every`` generations,
+read the guarded wrapper's on-device counters between dispatches, and on
+trigger rebuild the workflow around ``policy.algorithm_factory(pop *
+growth)`` — one recompile per doubling, amortized over the whole restart
+segment. Best-so-far (point and fitness) and the cumulative restart
+counter carry across the boundary; the fresh state re-centers on the
+best point (:func:`~evox_tpu.core.guardrail.recenter_state`).
+
+Checkpointing: each segment runs under the PR-2
+:class:`~evox_tpu.workflows.checkpoint.WorkflowCheckpointer` as usual,
+and the state is snapshotted immediately after every doubling. Resume
+correctness across a doubling relies on ``GuardedState.pop_size`` — a
+static (pickled) field recording the wrapped algorithm's λ — so
+:func:`resolve_ipop_resume` can rebuild the matching compiled program
+BEFORE restoring the snapshot; a crash before the post-doubling snapshot
+lands simply re-runs the segment from the previous snapshot and
+re-triggers the same (deterministic) doubling.
+
+Monitor caveat: monitor states ride across a doubling unchanged.
+TelemetryMonitor and EvalMonitor's top-k/Pareto buffers are batch-width
+independent and just keep accumulating; ``EvalMonitor(history_capacity=
+K)``'s ring is sized by the FIRST generation's batch and raises when the
+batch grows — use TelemetryMonitor rings with IPOP runs (GUIDE.md §6).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+from ..core.guardrail import GuardedState, IPOPRestarts, recenter_state
+from .checkpoint import WorkflowCheckpointer, _as_checkpointer
+
+__all__ = ["ipop_run", "resolve_ipop_resume"]
+
+
+def _require_guarded(astate: Any) -> None:
+    if not isinstance(astate, GuardedState):
+        raise TypeError(
+            "restarts=IPOPRestarts(...) needs the on-device detector: wrap "
+            "the algorithm in GuardedAlgorithm (core/guardrail.py) — the "
+            f"workflow state carries {type(astate).__name__} instead"
+        )
+
+
+def resolve_ipop_resume(
+    wf: Any, policy: IPOPRestarts, state: Any, n_steps: int, resume_from: Any
+) -> Tuple[Any, Any, int, WorkflowCheckpointer]:
+    """Restore the newest intact snapshot and rebuild the workflow at the
+    snapshot's (possibly doubled) population size. Returns ``(wf, state,
+    remaining_steps, checkpointer)``."""
+    ckpt = _as_checkpointer(resume_from)
+    loaded = ckpt.latest()
+    if loaded is not None:
+        _require_guarded(loaded.algo)
+        snap_pop = int(loaded.algo.pop_size)
+        if snap_pop and snap_pop != int(wf.algorithm.pop_size):
+            wf = wf.clone_with_algorithm(policy.make_algorithm(snap_pop))
+        state = loaded
+    return wf, state, max(n_steps - int(state.generation), 0), ckpt
+
+
+def _doublings_used(policy: IPOPRestarts, base_pop: int, cur_pop: int) -> int:
+    if cur_pop <= base_pop:
+        return 0
+    return round(math.log(cur_pop / base_pop) / math.log(policy.growth))
+
+
+def ipop_run(
+    wf: Any,
+    state: Any,
+    n_steps: int,
+    policy: IPOPRestarts,
+    segment: Callable[[Any, Any, int, Optional[WorkflowCheckpointer]], Any],
+    checkpointer: Optional[WorkflowCheckpointer] = None,
+    resume_from: Any = None,
+) -> Any:
+    """Drive ``segment`` (a fused or pipelined chunk runner) under the
+    IPOP policy. ``segment(wf, state, chunk, checkpointer) -> state`` runs
+    ``chunk`` generations of ``wf`` — everything between host checks stays
+    whatever dispatch shape the caller already uses."""
+    base_pop = int(wf.algorithm.pop_size)
+    if resume_from is not None:
+        wf, state, n_steps, resumed_ckpt = resolve_ipop_resume(
+            wf, policy, state, n_steps, resume_from
+        )
+        if checkpointer is None:
+            checkpointer = resumed_ckpt
+    _require_guarded(state.algo)
+
+    # Determinism contract (asserted in tests/test_numeric_chaos.py): a
+    # crashed/ended run resumed to the same total produces the straight
+    # run's state, INCLUDING the doubling schedule. Three pieces make the
+    # escalation decision a pure function of the (checkpointed) state:
+    # - chunks align to the GLOBAL check_every grid (like
+    #   checkpointed_run's cadence), so boundary generations never shift;
+    # - the trigger compares `restarts` against the persisted
+    #   `checked_restarts` baseline instead of host memory;
+    # - a resume landing exactly ON a boundary re-evaluates that
+    #   boundary's rule before dispatching (covers both a crash after the
+    #   segment's final snapshot and a completed run extended later).
+    remaining = n_steps
+    while remaining > 0:
+        if int(state.generation) % policy.check_every == 0:
+            wf, state = _maybe_double(wf, state, policy, base_pop, checkpointer)
+        gen = int(state.generation)
+        to_boundary = policy.check_every - gen % policy.check_every
+        chunk = min(remaining, to_boundary)
+        state = segment(wf, state, chunk, checkpointer)
+        remaining -= chunk
+    return state
+
+
+def _maybe_double(
+    wf: Any,
+    state: Any,
+    policy: IPOPRestarts,
+    base_pop: int,
+    checkpointer: Optional[WorkflowCheckpointer],
+) -> Tuple[Any, Any]:
+    """Evaluate the boundary escalation rule; on trigger rebuild the
+    workflow at the grown population, else just commit the baseline."""
+    algo_state = state.algo
+    used = _doublings_used(policy, base_pop, int(algo_state.pop_size) or base_pop)
+    triggered = int(algo_state.restarts) > int(algo_state.checked_restarts)
+    if policy.stagnation_limit is not None:
+        triggered = triggered or (
+            int(algo_state.stagnation) >= policy.stagnation_limit
+        )
+    if not triggered or used >= policy.max_restarts:
+        if int(algo_state.restarts) != int(algo_state.checked_restarts):
+            state = state.replace(
+                algo=algo_state.replace(checked_restarts=algo_state.restarts)
+            )
+        return wf, state
+
+    # -------------------------------------------------------- double λ
+    used += 1
+    new_pop = base_pop * policy.growth**used
+    algo2 = policy.make_algorithm(new_pop)
+    wf = wf.clone_with_algorithm(algo2)
+    # fresh state from the wrapper's restart stream (folded per doubling:
+    # deterministic, so a resumed run re-derives the identical successor)
+    fresh = algo2.init(jax.random.fold_in(algo_state.key, used))
+    fresh = fresh.replace(
+        inner=recenter_state(fresh.inner, algo_state.best_x),
+        best_x=algo_state.best_x,
+        best_fitness=algo_state.best_fitness,
+        restarts=algo_state.restarts,  # cumulative across the boundary
+        checked_restarts=algo_state.restarts,  # this trigger is consumed
+    )
+    state = state.replace(algo=fresh, first_step=True)
+    if checkpointer is not None:
+        # land the doubled state durably before running on it: a resume
+        # then rebuilds from GuardedState.pop_size directly (the save
+        # overwrites the segment's same-generation pre-doubling snapshot)
+        checkpointer.save(state)
+    return wf, state

@@ -38,6 +38,7 @@ def run_host_pipelined(
     on_generation: Optional[Callable[[int, Any, jax.Array], None]] = None,
     checkpointer: Optional[WorkflowCheckpointer] = None,
     resume_from: Any = None,
+    restarts: Any = None,
 ):
     """Run ``n_steps`` generations of ``wf`` (a :class:`StdWorkflow` whose
     problem is external/host-side), overlapping host evaluation with
@@ -62,6 +63,23 @@ def run_host_pipelined(
         raise ValueError(
             "run_host_pipelined is for external (host) problems; jittable "
             "problems should use wf.run()'s fused device loop"
+        )
+    if restarts is not None:
+        # host-boundary IPOP (workflows/ipop.py): chunk the pipelined loop
+        # at the policy cadence; each chunk is a plain pipelined run, the
+        # doubling decision happens between chunks on the guarded counters
+        from .ipop import ipop_run
+
+        return ipop_run(
+            wf,
+            state,
+            n_steps,
+            restarts,
+            segment=lambda w, s, c, ck: run_host_pipelined(
+                w, s, c, on_generation=on_generation, checkpointer=ck
+            ),
+            checkpointer=checkpointer,
+            resume_from=resume_from,
         )
     if resume_from is not None:
         state, n_steps = resolve_resume(resume_from, state, n_steps)

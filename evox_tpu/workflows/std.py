@@ -186,6 +186,26 @@ class StdWorkflow:
                         "resize the mesh, or pass allow_uneven_shards=True "
                         "to accept an unbalanced GSPMD layout"
                     )
+        # everything but the algorithm, for clone_with_algorithm (the IPOP
+        # driver rebuilds the workflow around a grown population). Built
+        # from the NORMALIZED attributes, not the raw arguments: a caller's
+        # one-shot iterable (monitors=iter([...])) is already exhausted by
+        # the tuple() above and would silently clone to an empty sequence
+        self._ctor_args = dict(
+            problem=self.problem,
+            monitors=self.monitors,
+            opt_direction=opt_direction,
+            pop_transforms=self.pop_transforms,
+            fit_transforms=self.fit_transforms,
+            mesh=self.mesh,
+            external_problem=self.external,
+            num_objectives=self.num_objectives,
+            jit_step=jit_step,
+            eval_shard_map=self.eval_shard_map,
+            allow_uneven_shards=allow_uneven_shards,
+            migrate_helper=self.migrate_helper,
+            quarantine_nonfinite=self.quarantine_nonfinite,
+        )
         for m in self.monitors:
             m.set_opt_direction(self.opt_direction)
         self._hook_table = build_hook_table(self.monitors)
@@ -196,6 +216,13 @@ class StdWorkflow:
         # jitted step halves for the host-overlap driver (pipelined.py)
         self._p_ask = jax.jit(self._pipeline_ask_impl) if jit_step else self._pipeline_ask_impl
         self._p_tell = jax.jit(self._pipeline_tell_impl) if jit_step else self._pipeline_tell_impl
+
+    def clone_with_algorithm(self, algorithm: Algorithm) -> "StdWorkflow":
+        """A new workflow identical to this one but driving ``algorithm``
+        (shared problem/monitor OBJECTS, fresh compiled closures) — the
+        host-boundary rebuild point for IPOP population growth
+        (workflows/ipop.py)."""
+        return StdWorkflow(algorithm, **self._ctor_args)
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> StdWorkflowState:
@@ -218,6 +245,7 @@ class StdWorkflow:
         n_steps: int,
         checkpointer: Optional[WorkflowCheckpointer] = None,
         resume_from: Any = None,
+        restarts: Any = None,
     ) -> StdWorkflowState:
         """Run ``n_steps`` generations as ONE compiled program.
 
@@ -240,7 +268,33 @@ class StdWorkflow:
         TOTAL generations, so a crashed run re-invoked with identical
         arguments completes the remaining generations and reproduces the
         straight run's final state.
+
+        ``restarts=`` (an :class:`~evox_tpu.core.guardrail.IPOPRestarts`,
+        requires the algorithm to be a ``GuardedAlgorithm``) adds
+        host-boundary IPOP population doubling: the run is chunked at the
+        policy's ``check_every`` cadence, the guarded wrapper's on-device
+        health counters are read between dispatches, and a triggered
+        restart rebuilds the workflow around a doubled population (one
+        recompile per doubling, best-so-far carried across; see
+        workflows/ipop.py). Composes with ``checkpointer``/``resume_from``
+        — a resumed run rebuilds the snapshot's population size first.
         """
+        if restarts is not None:
+            from .ipop import ipop_run
+
+            return ipop_run(
+                self,
+                state,
+                n_steps,
+                restarts,
+                segment=lambda w, s, c, ck: (
+                    checkpointed_run(w, s, c, ck)
+                    if ck is not None
+                    else fused_run(w, s, c)
+                ),
+                checkpointer=checkpointer,
+                resume_from=resume_from,
+            )
         if resume_from is not None:
             state, n_steps = resolve_resume(resume_from, state, n_steps)
             if checkpointer is None:

@@ -26,6 +26,14 @@ make every failure mode the farm/checkpointer must survive REPRODUCIBLE:
   after a step threshold, for in-process (HostRolloutFarm / workflow
   quarantine) tests without any sockets.
 
+- numeric state poisoning (PR 3): :func:`poison_algo_field` surgically
+  corrupts a field of the (possibly guarded) algorithm state — NaN into
+  CMA-ES's covariance, ``sigma -> 0``, and friends — to reproduce the
+  failure class restart strategies recover from; :class:`PlateauSphere`
+  and :class:`HostPlateauSphere` are fitness plateaus (device / host
+  flavor) that starve any improvement signal, the deterministic trigger
+  for stagnation guards. Consumed by tests/test_numeric_chaos.py.
+
 Everything here is deterministic — no random fault timing — so the
 chaos tests assert exact outcomes (bit-identical fitness, pytree
 equality) rather than "usually survives".
@@ -148,6 +156,75 @@ def spawn_chaos_worker(
     )
     p.start()
     return p
+
+
+# --------------------------------------------------------------------------
+# numeric (algorithm-state) fault injection
+
+
+def poison_algo_field(wf_state, field_name: str, value):
+    """Return a copy of a workflow state with ``field_name`` of the
+    algorithm state overwritten by ``value`` (broadcast to the field's
+    shape, cast to its dtype). Sees through a GuardedAlgorithm wrapper:
+    when the algorithm state is a ``GuardedState``, the INNER state is
+    poisoned — the realistic fault is inside the wrapped algorithm's
+    math, not the wrapper's bookkeeping."""
+    import jax.numpy as jnp
+
+    from evox_tpu.core.guardrail import GuardedState
+
+    astate = wf_state.algo
+    if isinstance(astate, GuardedState):
+        inner = astate.inner
+        cur = getattr(inner, field_name)
+        poisoned = jnp.full_like(cur, value)
+        return wf_state.replace(
+            algo=astate.replace(inner=inner.replace(**{field_name: poisoned}))
+        )
+    cur = getattr(astate, field_name)
+    poisoned = jnp.full_like(cur, value)
+    return wf_state.replace(algo=astate.replace(**{field_name: poisoned}))
+
+
+class PlateauSphere:
+    """Sphere whose fitness is floored to a constant beyond a radius —
+    inside jit. Every candidate outside ``radius`` scores exactly
+    ``plateau``, so a search that starts far away receives ZERO
+    improvement signal: the deterministic trigger for stagnation-based
+    restarts (a run re-centered near the optimum escapes the plateau and
+    converges, which is what the recovery tests assert). Duck-typed
+    Problem (jittable/fit_shape/fit_dtype), no base class needed."""
+
+    jittable = True
+    fit_dtype = "float32"
+
+    def __init__(self, radius: float = 4.0, plateau: float = 1e3):
+        self.radius = radius
+        self.plateau = plateau
+
+    def init(self, key=None):
+        return None
+
+    def fit_shape(self, pop_size):
+        return (pop_size,)
+
+    def evaluate(self, state, pop):
+        import jax.numpy as jnp
+
+        sq = jnp.sum(pop**2, axis=-1)
+        return jnp.where(sq > self.radius**2, self.plateau, sq), state
+
+
+class HostPlateauSphere(PlateauSphere):
+    """Host (non-jittable) flavor of :class:`PlateauSphere`, for driving
+    the same stagnation/restart scenarios through ``run_host_pipelined``."""
+
+    jittable = False
+
+    def evaluate(self, state, pop):
+        sq = np.sum(np.asarray(pop) ** 2, axis=-1)
+        out = np.where(sq > self.radius**2, self.plateau, sq)
+        return out.astype(np.float32), state
 
 
 class NaNEnv:

@@ -100,6 +100,30 @@ def test_telemetry_modules_exist_and_are_callback_free():
         assert rel not in users, f"{rel} must not use host callbacks"
 
 
+def test_guardrail_modules_are_callback_free():
+    """The numerical self-defense layer must run on the callback-less
+    axon backend by construction: GuardedAlgorithm's predicates/restart
+    are pure lax math, the sanitizer is elementwise, the IPOP driver is
+    host-side BETWEEN dispatches, and the chaos harness's poison helpers
+    must stay injectable into traced state without host traffic."""
+    users = _scan()
+    for rel in (
+        "core/guardrail.py",
+        "operators/sanitize.py",
+        "workflows/ipop.py",
+    ):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"
+    # tests/_chaos.py lives outside the package tree the scanner walks:
+    # scan it directly (its fault injectors run inside jitted steps)
+    chaos = pathlib.Path(__file__).resolve().parent / "_chaos.py"
+    tree = ast.parse(chaos.read_text(), filename=str(chaos))
+    assert not _uses_host_callbacks(tree), (
+        "tests/_chaos.py must stay callback-free: its poison helpers and "
+        "plateau problems are used inside jitted steps on the axon backend"
+    )
+
+
 def test_fault_tolerance_modules_are_callback_free():
     """The self-healing stack must work on the callback-less axon backend
     by construction: WorkflowCheckpointer snapshots host-side between

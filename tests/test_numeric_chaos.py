@@ -1,0 +1,492 @@
+"""Numeric fault injection: degenerate-state detection, restarts, laws.
+
+The algorithm-side complement of tests/test_chaos.py (which covers the
+evaluation side): poison the SEARCH STATE itself — NaN into CMA-ES's
+covariance factorization, sigma collapsed to zero, plateau fitness — and
+assert the numerical self-defense layer (core/guardrail.py +
+workflows/ipop.py) detects, restarts, and recovers, while the two laws
+hold:
+
+- **No-trigger law**: ``GuardedAlgorithm(alg)`` with guards ENABLED but
+  never triggered is BIT-identical to bare ``alg`` — across ``step()``
+  loops, the fused ``run()`` fori_loop on the 8-device CPU mesh, and
+  ``run_host_pipelined``.
+- **Recovery law**: a guarded CMA-ES whose covariance is poisoned at
+  generation K detects, restarts re-centered on best-so-far, and still
+  reaches the Sphere convergence threshold; the unguarded run
+  demonstrably does not.
+
+All fault timing is deterministic (explicit poison between steps), so
+every assertion is exact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evox_tpu import GuardedAlgorithm, IPOPRestarts, StdWorkflow, create_mesh
+from evox_tpu.algorithms import CMAES, DE, PSO
+from evox_tpu.core.guardrail import (
+    TRIGGER_NONFINITE,
+    TRIGGER_SIGMA,
+    TRIGGER_STAGNATION,
+    recenter_state,
+)
+from evox_tpu.monitors import TelemetryMonitor
+from evox_tpu.problems.numerical import Sphere
+from evox_tpu.workflows import WorkflowCheckpointer, run_host_pipelined
+
+from tests._chaos import HostPlateauSphere, PlateauSphere, poison_algo_field
+
+pytestmark = pytest.mark.chaos
+
+DIM = 5
+
+
+def tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def make_cmaes(pop=16):
+    return CMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=pop)
+
+
+def make_de(pop=16):
+    return DE(lb=jnp.full((DIM,), -5.0), ub=jnp.full((DIM,), 5.0), pop_size=pop)
+
+
+def make_pso(pop=16):
+    return PSO(lb=jnp.full((DIM,), -5.0), ub=jnp.full((DIM,), 5.0), pop_size=pop)
+
+
+# --------------------------------------------------------- no-trigger law
+@pytest.mark.parametrize("make", [make_cmaes, make_de, make_pso],
+                         ids=["CMAES", "DE", "PSO"])
+def test_no_trigger_bit_identity_step_loop(make):
+    """Guards enabled (NaN check + default sigma rails + a stagnation
+    limit no healthy run reaches) but never triggered: every leaf of the
+    wrapped state equals the bare algorithm's, bit for bit."""
+    key = jax.random.PRNGKey(7)
+    wf_bare = StdWorkflow(make(), Sphere())
+    wf_guard = StdWorkflow(
+        GuardedAlgorithm(make(), stagnation_limit=10_000), Sphere()
+    )
+    sb, sg = wf_bare.init(key), wf_guard.init(key)
+    for _ in range(12):  # divergence, if any, appears at the first step
+        sb, sg = wf_bare.step(sb), wf_guard.step(sg)
+    assert int(sg.algo.restarts) == 0
+    assert tree_equal(sb.algo, sg.algo.inner)
+
+
+def test_no_trigger_bit_identity_fused_run_on_mesh():
+    """Same law through ONE compiled fori_loop on the 8-device mesh."""
+    assert jax.device_count() >= 8
+    mesh = create_mesh()
+    key = jax.random.PRNGKey(11)
+    wf_bare = StdWorkflow(make_cmaes(), Sphere(), mesh=mesh)
+    wf_guard = StdWorkflow(
+        GuardedAlgorithm(make_cmaes(), stagnation_limit=10_000),
+        Sphere(),
+        mesh=mesh,
+    )
+    sb = wf_bare.run(wf_bare.init(key), 40)
+    sg = wf_guard.run(wf_guard.init(key), 40)
+    assert int(sg.algo.restarts) == 0
+    assert tree_equal(sb.algo, sg.algo.inner)
+
+
+def test_no_trigger_bit_identity_pipelined():
+    """Same law through run_host_pipelined (host evaluation thread,
+    init_ask-dispatching algorithm)."""
+    key = jax.random.PRNGKey(13)
+    prob = HostPlateauSphere(radius=1e6)  # host Sphere (plateau unreachable)
+    wf_bare = StdWorkflow(make_de(), prob)
+    wf_guard = StdWorkflow(GuardedAlgorithm(make_de()), prob)
+    sb = run_host_pipelined(wf_bare, wf_bare.init(key), 15)
+    sg = run_host_pipelined(wf_guard, wf_guard.init(key), 15)
+    assert int(sg.algo.restarts) == 0
+    assert tree_equal(sb.algo, sg.algo.inner)
+
+
+# ---------------------------------------------------------- recovery law
+def test_nan_covariance_guarded_recovers_unguarded_does_not():
+    """Poison the covariance AND its factorization at generation K (what
+    a non-finite eigh leaves behind): the guarded run detects the
+    non-finite state at the next tell, restarts re-centered on
+    best-so-far, and still reaches the Sphere threshold; the unguarded
+    run's mean goes NaN and never produces a finite candidate again."""
+    key = jax.random.PRNGKey(3)
+    K, total = 10, 200
+
+    def poisoned_run(wf):
+        state = wf.init(key)
+        for _ in range(K):
+            state = wf.step(state)
+        for f in ("C", "B", "D"):
+            state = poison_algo_field(state, f, jnp.nan)
+        for _ in range(total - K):
+            state = wf.step(state)
+        return state
+
+    guard = GuardedAlgorithm(make_cmaes())
+    mon = TelemetryMonitor(capacity=8)
+    wf_g = StdWorkflow(guard, Sphere(), monitors=[mon])
+    sg = poisoned_run(wf_g)
+    assert int(sg.algo.restarts) >= 1
+    assert float(sg.algo.best_fitness) < 0.01  # Sphere threshold, guarded
+    assert bool(jnp.all(jnp.isfinite(jnp.asarray(jax.tree.leaves(sg.algo.inner)[0]))))
+
+    wf_b = StdWorkflow(make_cmaes(), Sphere(), monitors=[TelemetryMonitor(capacity=8)])
+    sb = poisoned_run(wf_b)
+    # unguarded: the poisoned factorization flows through tell into the
+    # mean — the state is NaN forever and no finite fitness ever returns
+    assert bool(jnp.any(~jnp.isfinite(sb.algo.mean)))
+    best_b = sb.monitors[0].best_key  # internal min key, inf = no finite seen
+    assert not float(best_b) < 0.01
+
+
+def test_sigma_collapse_triggers_and_restores_exploration():
+    key = jax.random.PRNGKey(5)
+    guard = GuardedAlgorithm(make_cmaes())
+    wf = StdWorkflow(guard, Sphere())
+    state = wf.init(key)
+    for _ in range(5):
+        state = wf.step(state)
+    state = poison_algo_field(state, "sigma", 0.0)
+    state = wf.step(state)  # tell sees sigma below the floor
+    assert int(state.algo.restarts) == 1
+    assert int(state.algo.last_trigger) & TRIGGER_SIGMA
+    # exploration restored: fresh init sigma, re-centered on best-so-far
+    assert float(state.algo.inner.sigma) > 0.1
+    np.testing.assert_allclose(
+        np.asarray(state.algo.inner.mean), np.asarray(state.algo.best_x)
+    )
+
+    # unguarded: the rail pins sigma at the floor — no NaN, but the
+    # search is frozen (candidates equal the mean to f32 resolution)
+    wf_b = StdWorkflow(make_cmaes(), Sphere())
+    sb = wf_b.init(key)
+    for _ in range(5):
+        sb = wf_b.step(sb)
+    sb = poison_algo_field(sb, "sigma", 0.0)
+    sb = wf_b.step(sb)
+    assert float(sb.algo.sigma) <= 1e-19
+
+
+def test_plateau_stagnation_restart_recovers():
+    """DE on a mostly-plateau landscape (dim 2, bowl of radius 1 in ±5
+    bounds — ~3% of the box): with PRNGKey(0) the initial population
+    misses the bowl entirely, so fitness flatlines and the stagnation
+    guard restarts with fresh uniform populations until one lands inside
+    the bowl and real convergence resumes. Deterministic for this seed
+    (the guard's restart stream is folded off it)."""
+    algo = GuardedAlgorithm(
+        DE(lb=jnp.full((2,), -5.0), ub=jnp.full((2,), 5.0), pop_size=32),
+        stagnation_limit=8,
+    )
+    prob = PlateauSphere(radius=1.0, plateau=1e3)
+    wf = StdWorkflow(algo, prob)
+    state = wf.init(jax.random.PRNGKey(0))
+    # seed contract: generation 0 sits entirely on the plateau
+    pop0, _ = algo.init_ask(state.algo)
+    assert bool(jnp.all(jnp.sum(pop0**2, axis=-1) > 1.0))
+    state = wf.run(state, 200)
+    assert int(state.algo.restarts) >= 1
+    assert float(state.algo.best_fitness) < 1.0  # found and entered the bowl
+
+
+def test_nonfinite_trigger_code_recorded():
+    key = jax.random.PRNGKey(9)
+    guard = GuardedAlgorithm(make_cmaes())
+    wf = StdWorkflow(guard, Sphere())
+    state = wf.init(key)
+    state = wf.step(state)
+    state = poison_algo_field(state, "pc", jnp.nan)
+    state = wf.step(state)
+    assert int(state.algo.restarts) == 1
+    assert int(state.algo.last_trigger) & TRIGGER_NONFINITE
+    report = guard.health_report(state.algo)
+    assert report["restarts"] == 1
+    assert "nonfinite_state" in report["last_trigger_names"]
+
+
+def test_stagnation_trigger_code():
+    algo = GuardedAlgorithm(
+        DE(lb=jnp.full((2,), -5.0), ub=jnp.full((2,), 5.0), pop_size=16),
+        stagnation_limit=5,
+    )
+    # radius 0: the whole box is plateau, stagnation is unconditional
+    wf = StdWorkflow(algo, PlateauSphere(radius=0.0))
+    state = wf.init(jax.random.PRNGKey(42))
+    restarted = False
+    for _ in range(20):
+        state = wf.step(state)
+        if int(state.algo.restarts) > 0:
+            restarted = True
+            break
+    assert restarted
+    assert int(state.algo.last_trigger) & TRIGGER_STAGNATION
+    assert int(state.algo.stagnation) == 0  # counter reset by the restart
+
+
+def test_recenter_state_variants():
+    from evox_tpu.algorithms import AMaLGaM
+
+    best = jnp.arange(DIM, dtype=jnp.float32)
+    # mean-based state
+    cma_state = make_cmaes().init(jax.random.PRNGKey(0))
+    rc = recenter_state(cma_state, best)
+    np.testing.assert_array_equal(np.asarray(rc.mean), np.asarray(best))
+    # numpy best (checkpoint-restored leaves) must work identically
+    rc2 = recenter_state(cma_state, np.asarray(best))
+    np.testing.assert_array_equal(np.asarray(rc2.mean), np.asarray(best))
+    # population-based state: best seeded into row 0, rest untouched
+    de_state = make_de().init(jax.random.PRNGKey(0))
+    rd = recenter_state(de_state, best)
+    np.testing.assert_array_equal(np.asarray(rd.population[0]), np.asarray(best))
+    np.testing.assert_array_equal(
+        np.asarray(rd.population[1:]), np.asarray(de_state.population[1:])
+    )
+
+
+# ------------------------------------------------------------------ IPOP
+def ipop_factory(pop):
+    return GuardedAlgorithm(make_cmaes(pop), sigma_floor=1e-2)
+
+
+def test_ipop_doubles_population_and_reaches_threshold():
+    policy = IPOPRestarts(ipop_factory, max_restarts=3, check_every=25)
+    wf = StdWorkflow(ipop_factory(8), Sphere())
+    state = wf.run(wf.init(jax.random.PRNGKey(0)), 150, restarts=policy)
+    assert int(state.algo.pop_size) > 8  # at least one doubling happened
+    assert int(state.algo.restarts) >= 1
+    assert float(state.algo.best_fitness) < 0.01
+
+
+@pytest.mark.slow  # ~19 s: three full IPOP runs + two resumes
+def test_ipop_checkpoint_resume_equivalence(tmp_path):
+    """Crash mid-run OR stop-and-extend: resuming to the same total
+    reproduces the straight run bit-for-bit, including the doubling
+    schedule (GuardedState.pop_size static field + grid-aligned checks +
+    the persisted checked_restarts baseline)."""
+    policy = IPOPRestarts(ipop_factory, max_restarts=3, check_every=25)
+    key = jax.random.PRNGKey(0)
+
+    wf_full = StdWorkflow(ipop_factory(8), Sphere())
+    s_full = wf_full.run(
+        wf_full.init(key), 150, restarts=policy,
+        checkpointer=WorkflowCheckpointer(str(tmp_path / "full"), every=25),
+    )
+    # stop exactly at a boundary (pending doubling decision), then extend
+    wf_a = StdWorkflow(ipop_factory(8), Sphere())
+    wf_a.run(
+        wf_a.init(key), 75, restarts=policy,
+        checkpointer=WorkflowCheckpointer(str(tmp_path / "a"), every=25),
+    )
+    wf_a2 = StdWorkflow(ipop_factory(8), Sphere())
+    s_a = wf_a2.run(
+        wf_a2.init(key), 150, restarts=policy, resume_from=str(tmp_path / "a")
+    )
+    assert tree_equal(s_full, s_a)
+    # crash at an interior generation (checkpoint cadence != check cadence)
+    wf_b = StdWorkflow(ipop_factory(8), Sphere())
+    wf_b.run(
+        wf_b.init(key), 60, restarts=policy,
+        checkpointer=WorkflowCheckpointer(str(tmp_path / "b"), every=10),
+    )
+    wf_b2 = StdWorkflow(ipop_factory(8), Sphere())
+    s_b = wf_b2.run(
+        wf_b2.init(key), 150, restarts=policy, resume_from=str(tmp_path / "b")
+    )
+    assert tree_equal(s_full, s_b)
+    assert int(s_full.algo.pop_size) > 8  # the schedule actually doubled
+
+
+def test_ipop_pipelined_host_problem():
+    """IPOP escalation through run_host_pipelined (stagnation-driven: a
+    total plateau on the host side — every boundary check sees the
+    stagnation counter over the limit and escalates to the budget)."""
+    def factory(pop):
+        return GuardedAlgorithm(
+            DE(lb=jnp.full((2,), -5.0), ub=jnp.full((2,), 5.0), pop_size=pop),
+            stagnation_limit=10_000,  # device restart off; host owns it
+        )
+
+    policy = IPOPRestarts(
+        factory, max_restarts=2, check_every=10, stagnation_limit=8
+    )
+    prob = HostPlateauSphere(radius=0.0)
+    wf = StdWorkflow(factory(8), prob)
+    state = run_host_pipelined(
+        wf, wf.init(jax.random.PRNGKey(2)), 60, restarts=policy
+    )
+    assert int(state.algo.pop_size) == 8 * policy.growth**policy.max_restarts
+    assert int(state.generation) == 60
+
+
+def test_ipop_requires_guarded_algorithm():
+    policy = IPOPRestarts(ipop_factory, max_restarts=1, check_every=10)
+    wf = StdWorkflow(make_cmaes(8), Sphere())
+    with pytest.raises(TypeError, match="GuardedAlgorithm"):
+        wf.run(wf.init(jax.random.PRNGKey(0)), 30, restarts=policy)
+
+
+def test_ipop_factory_type_check():
+    with pytest.raises(TypeError, match="GuardedAlgorithm"):
+        IPOPRestarts(lambda pop: make_cmaes(pop)).make_algorithm(8)
+
+
+# --------------------------------------------------- sanitizer properties
+def test_sanitize_bounds_properties():
+    from evox_tpu.operators.sanitize import sanitize_bounds
+
+    lb = jnp.asarray([-1.0, 0.0, -3.0])
+    ub = jnp.asarray([1.0, 2.0, -1.0])
+    x = jnp.asarray(
+        [[0.5, 1.0, -2.0],  # inside: every method must return unchanged
+         [1.7, -0.5, -0.5],  # outside
+         [jnp.nan, jnp.inf, -2.0]]  # non-finite: must STAY visible
+    )
+    for method in ("clip", "reflect", "wrap"):
+        out = sanitize_bounds(x, lb, ub, method)
+        finite_rows = out[:2]
+        assert bool(jnp.all((finite_rows >= lb) & (finite_rows <= ub))), method
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(x[0]),
+                                   err_msg=method)
+        # poison is NOT silently repaired into a legitimate point: it must
+        # remain non-finite so TelemetryMonitor counters / quarantine /
+        # GuardedAlgorithm see it (the designed handling path)
+        assert not bool(jnp.all(jnp.isfinite(out[2][:2]))), method
+    # clip is the legacy behavior exactly, non-finite included
+    np.testing.assert_array_equal(
+        np.asarray(sanitize_bounds(x, lb, ub, "clip")),
+        np.asarray(jnp.clip(x, lb, ub)),
+    )
+    # reflect: mirror of the overshoot
+    out = sanitize_bounds(jnp.asarray([[1.7, -0.5, -0.5]]), lb, ub, "reflect")
+    np.testing.assert_allclose(np.asarray(out[0]), [0.3, 0.5, -1.5], rtol=1e-6)
+    # wrap: toroidal
+    out = sanitize_bounds(jnp.asarray([[1.7, -0.5, -0.5]]), lb, ub, "wrap")
+    np.testing.assert_allclose(np.asarray(out[0]), [-0.3, 1.5, -2.5], rtol=1e-6)
+    with pytest.raises(ValueError, match="bound_handling"):
+        sanitize_bounds(x, lb, ub, "project")
+
+
+def test_de_bound_handling_param_validation():
+    with pytest.raises(ValueError, match="bound_handling"):
+        DE(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8, bound_handling="nope")
+    with pytest.raises(ValueError, match="bound_handling"):
+        PSO(lb=jnp.zeros(2), ub=jnp.ones(2), pop_size=8, bound_handling="nope")
+
+
+def test_de_reflect_stays_in_bounds_under_workflow():
+    algo = DE(
+        lb=jnp.full((DIM,), -5.0), ub=jnp.full((DIM,), 5.0), pop_size=16,
+        bound_handling="reflect",
+    )
+    wf = StdWorkflow(algo, Sphere())
+    state = wf.init(jax.random.PRNGKey(1))
+    for _ in range(10):
+        pop, _ = algo.ask(state.algo)
+        assert bool(jnp.all((pop >= algo.lb) & (pop <= algo.ub)))
+        state = wf.step(state)
+
+# ------------------------------------------------- observability exports
+def test_telemetry_and_run_report_carry_guardrail_counters():
+    """Satellite contract: restarts/health counters reach
+    TelemetryMonitor.report() (mirrored in post_step) and run_report()
+    (top-level guardrail section) without the caller touching the
+    algorithm state."""
+    from evox_tpu import run_report
+
+    guard = GuardedAlgorithm(make_cmaes())
+    mon = TelemetryMonitor(capacity=8)
+    wf = StdWorkflow(guard, Sphere(), monitors=[mon])
+    state = wf.init(jax.random.PRNGKey(1))
+    for _ in range(3):
+        state = wf.step(state)
+    state = poison_algo_field(state, "sigma", 0.0)
+    state = wf.step(state)
+
+    rep = mon.report(state.monitors[0])
+    assert rep["restarts"] == 1
+    assert rep["last_trigger"] & TRIGGER_SIGMA
+
+    full = run_report(wf, state)
+    assert full["guardrail"]["restarts"] == 1
+    assert "sigma_collapse" in full["guardrail"]["last_trigger_names"]
+    import json
+
+    json.dumps(full, allow_nan=False)  # strictly JSON-serializable
+
+    # unguarded workflows: counters exist, stay zero, no guardrail section
+    mon2 = TelemetryMonitor(capacity=8)
+    wf2 = StdWorkflow(make_cmaes(), Sphere(), monitors=[mon2])
+    s2 = wf2.init(jax.random.PRNGKey(1))
+    s2 = wf2.step(s2)
+    assert mon2.report(s2.monitors[0])["restarts"] == 0
+    assert "guardrail" not in run_report(wf2, s2)
+
+
+def test_no_trigger_bit_identity_variable_batch_width():
+    """Regression: CSO evaluates the full population on generation 0 and
+    half-batches after — the wrapper's candidate buffer must keep one
+    static shape across the fused run()'s fori_loop carry (sized to the
+    widest batch, sliced to the live batch in tell)."""
+    from evox_tpu.algorithms import CSO
+
+    make = lambda: CSO(lb=jnp.full((4,), -5.0), ub=jnp.full((4,), 5.0), pop_size=8)  # noqa: E731
+    key = jax.random.PRNGKey(1)
+    wf_g = StdWorkflow(GuardedAlgorithm(make(), stagnation_limit=10_000), Sphere())
+    wf_b = StdWorkflow(make(), Sphere())
+    sg = wf_g.run(wf_g.init(key), 10)  # raised a carry-type error before
+    sb = wf_b.run(wf_b.init(key), 10)
+    assert int(sg.algo.restarts) == 0
+    assert tree_equal(sb.algo, sg.algo.inner)
+
+
+def test_per_axis_sigma_collapse_detected():
+    """SNES carries sigma of shape (dim,): ONE frozen axis is degenerate
+    even while the others stay healthy (floor checks jnp.min, not max)."""
+    from evox_tpu.algorithms import SNES
+
+    algo = GuardedAlgorithm(
+        SNES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16),
+        sigma_floor=1e-6,
+    )
+    wf = StdWorkflow(algo, Sphere())
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.step(state)
+    sig = state.algo.inner.sigma.at[2].set(1e-12)
+    state = state.replace(
+        algo=state.algo.replace(inner=state.algo.inner.replace(sigma=sig))
+    )
+    state = wf.step(state)
+    assert int(state.algo.restarts) == 1
+    assert int(state.algo.last_trigger) & TRIGGER_SIGMA
+
+
+def test_migrate_updates_best_so_far():
+    """A migrant better than the wrapper's best must refresh best-so-far
+    and clear stagnation — otherwise the stagnation guard fires a
+    spurious restart that re-centers on a stale pre-migration best."""
+    algo = GuardedAlgorithm(make_pso(), stagnation_limit=50)
+    state = algo.init(jax.random.PRNGKey(0))
+    pop, state = algo.init_ask(state)
+    fitness = jnp.sum(pop**2, axis=-1)
+    state = algo.init_tell(state, fitness)
+    state = state.replace(stagnation=jnp.asarray(40, jnp.int32))
+    migrant = jnp.zeros((1, DIM))
+    state = algo.migrate(state, migrant, jnp.zeros((1,)))
+    assert float(state.best_fitness) == 0.0
+    np.testing.assert_array_equal(np.asarray(state.best_x), np.zeros(DIM))
+    assert int(state.stagnation) == 0
+    # a WORSE migrant leaves best/stagnation untouched
+    state = state.replace(stagnation=jnp.asarray(7, jnp.int32))
+    state = algo.migrate(state, jnp.full((1, DIM), 9.0), jnp.asarray([405.0]))
+    assert float(state.best_fitness) == 0.0
+    assert int(state.stagnation) == 7

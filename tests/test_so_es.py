@@ -292,3 +292,53 @@ def test_les_meta_transfers_to_unseen_families():
             wins += 1
         print(f"{fam.__name__}: trained {t_score:.2f} vs OpenES {o_score:.2f}")
     assert wins >= 2, "meta-trained LES must beat OpenES on both unseen families"
+
+
+# ---- restart strategies (PR 3) ---------------------------------------------
+# Convergence-threshold tests for the restart-capable surface: the in-place
+# restart variants (previously smoke-only — no test referenced them at all)
+# and the CMA family under GuardedAlgorithm. The bare-algorithm thresholds
+# live in the per-algorithm tests above; the guarded runs must match them
+# (guards enabled, never triggered — the no-trigger law makes the wrapped
+# trajectory identical, asserted bitwise in tests/test_numeric_chaos.py).
+
+from evox_tpu.algorithms.so.es import IPOPCMAES, BIPOPCMAES  # noqa: E402
+from evox_tpu.core.guardrail import GuardedAlgorithm  # noqa: E402
+
+
+@pytest.mark.slow  # restart surface; the 870 s tier-1 gate keeps the
+# plain-CMAES guarded run below as its representative
+def test_ipop_cmaes_converges():
+    algo = IPOPCMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16)
+    assert run_algorithm(algo, 200) < 0.01
+
+
+@pytest.mark.slow
+def test_bipop_cmaes_converges():
+    algo = BIPOPCMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16)
+    assert run_algorithm(algo, 200) < 0.01
+
+
+_GUARDED_CASES = [
+    ("CMAES", lambda: CMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16), 200, 0.01),
+    ("SepCMAES", lambda: SepCMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32), 300, 0.1),
+    ("MAES", lambda: MAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16), 200, 0.01),
+    ("LMMAES", lambda: LMMAES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=16), 300, 0.1),
+    ("RMES", lambda: RMES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32), 400, 0.1),
+    ("CR_FM_NES", lambda: CR_FM_NES(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=32), 300, 0.1),
+    ("AMaLGaM", lambda: AMaLGaM(center_init=jnp.full((DIM,), 3.0), init_stdev=1.0, pop_size=64), 300, 0.1),
+]
+
+
+@pytest.mark.parametrize(
+    "make,steps,threshold",
+    [
+        c[1:] if c[0] == "CMAES"
+        else pytest.param(*c[1:], marks=pytest.mark.slow)
+        for c in _GUARDED_CASES
+    ],
+    ids=[c[0] for c in _GUARDED_CASES],
+)
+def test_guarded_cma_family_converges(make, steps, threshold):
+    algo = GuardedAlgorithm(make(), stagnation_limit=10_000)
+    assert run_algorithm(algo, steps) < threshold

@@ -1,0 +1,271 @@
+"""Static/structural enforcement of the state-layout convention.
+
+CLAUDE.md: "Every state is a frozen PyTreeNode; annotate population-leading
+fields ``field(sharding=P(POP_AXIS))``, the rest ``field(sharding=P())`` —
+the workflow applies layouts each step via ``constrain_state``." Until this
+test, the convention was enforced by review only; a forgotten annotation
+silently pessimizes mesh runs (the leaf is left to GSPMD propagation
+instead of its declared layout) or — worse — a wrong ``P(POP_AXIS)`` on a
+replicated leaf breaks divisibility on the 8-device mesh.
+
+Mechanics: every registered algorithm (``evox_tpu.algorithms.__all__``)
+whose constructor we can satisfy from a standard argument pool is
+instantiated with ``pop_size=8`` in ``dim=5`` (different values, so a
+leading axis equal to 8 really is the population axis), its state is
+built with ``init(key)``, and each dataclass field is checked against the
+actual leaf shapes:
+
+- a field with any leaf whose leading axis == pop_size must be annotated
+  ``P(POP_AXIS)``;
+- every other (non-static) field must be annotated ``P()``;
+- the state class must be a frozen dataclass registered as a JAX pytree.
+
+Monitor states get the same structural checks (their buffers are
+capacity-leading, never population-leading, so everything is ``P()``).
+Classes the pool cannot construct are skipped EXPLICITLY — a baseline
+assertion pins the set of covered classes so coverage can only grow.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import evox_tpu
+from evox_tpu.core.distributed import POP_AXIS
+from evox_tpu.core.guardrail import GuardedAlgorithm
+from evox_tpu.core.struct import PyTreeNode
+
+POP = 8
+DIM = 5
+N_OBJS = 3
+
+# constructor argument pool, matched by parameter name
+ARG_POOL = {
+    "lb": jnp.full((DIM,), -5.0),
+    "ub": jnp.full((DIM,), 5.0),
+    "center_init": jnp.full((DIM,), 1.0),
+    "init_stdev": 1.0,
+    "pop_size": POP,
+    "dim": DIM,
+    "n_objs": N_OBJS,
+    "learning_rate": 0.1,
+    "noise_stdev": 0.2,
+}
+
+
+# per-class constructor overrides where the pool's defaults violate a
+# constructor constraint (shapes stay distinguishable: pop != DIM)
+CTOR_OVERRIDES = {
+    "ESMC": {"center_init": ARG_POOL["center_init"], "pop_size": 9},
+    # default memory_size is 8 at DIM=5 — collides with POP, which would
+    # misclassify the (memory, dim) transform archive as population-leading
+    "LMMAES": {
+        "center_init": ARG_POOL["center_init"],
+        "init_stdev": 1.0,
+        "pop_size": POP,
+        "memory_size": 3,
+    },
+}
+
+# fallback positional idioms for subclasses with (*args, **kwargs) ctors
+FALLBACK_KWARGS = (
+    {"lb": ARG_POOL["lb"], "ub": ARG_POOL["ub"], "pop_size": POP},
+    {
+        "lb": ARG_POOL["lb"],
+        "ub": ARG_POOL["ub"],
+        "n_objs": N_OBJS,
+        "pop_size": POP,
+    },
+    {
+        "center_init": ARG_POOL["center_init"],
+        "init_stdev": 1.0,
+        "pop_size": POP,
+    },
+)
+
+
+def _construct(cls, name=None):
+    """Instantiate ``cls`` from the argument pool, or None if a required
+    parameter is not in the pool."""
+    import inspect
+
+    if name in CTOR_OVERRIDES:
+        try:
+            return cls(**CTOR_OVERRIDES[name])
+        except Exception:
+            return None
+    try:
+        sig = inspect.signature(cls.__init__)
+    except (TypeError, ValueError):  # pragma: no cover
+        return None
+    kwargs = {}
+    var_args = False
+    for pname, p in list(sig.parameters.items())[1:]:  # skip self
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            var_args = True
+            continue
+        if pname in ARG_POOL:
+            kwargs[pname] = ARG_POOL[pname]
+        elif p.default is p.empty:
+            return None
+    try:
+        return cls(**kwargs)
+    except Exception:
+        if not var_args:
+            return None
+    for fb in FALLBACK_KWARGS:  # (*args, **kwargs) subclasses
+        try:
+            return cls(**fb)
+        except Exception:
+            continue
+    return None
+
+
+def _algorithm_classes():
+    from evox_tpu.core.algorithm import Algorithm
+
+    seen = {}
+    for name in evox_tpu.algorithms.__all__:
+        obj = getattr(evox_tpu.algorithms, name, None)
+        if isinstance(obj, type) and issubclass(obj, Algorithm):
+            seen[name] = obj
+    return seen
+
+
+def _iter_state_fields(state, prefix=""):
+    """Yield (path, field, value) for every dataclass field, recursing
+    into PyTreeNode-valued fields (wrappers/containers)."""
+    for f in dataclasses.fields(state):
+        value = getattr(state, f.name)
+        path = f"{prefix}{f.name}"
+        yield path, f, value
+        if dataclasses.is_dataclass(value):
+            yield from _iter_state_fields(value, prefix=f"{path}.")
+
+
+def _check_state(state, where, pop=POP):
+    errors = []
+    assert dataclasses.is_dataclass(state), f"{where}: state is not a dataclass"
+    assert type(state).__dataclass_params__.frozen, f"{where}: not frozen"
+    # registered as a pytree: flatten must not treat it as a leaf
+    leaves = jax.tree.leaves(state)
+    assert not any(l is state for l in leaves), f"{where}: not a pytree"
+    for path, f, value in _iter_state_fields(state):
+        if f.metadata.get("static", False):
+            continue
+        spec = f.metadata.get("sharding")
+        field_leaves = [
+            jnp.asarray(x)
+            for x in jax.tree.leaves(value)
+            if hasattr(x, "shape") or not isinstance(x, (type(None), str))
+        ]
+        # pop-leading: leading axis is the population size or a multiple
+        # of it (CoDE's 3-trials-per-parent batch is (3*pop, dim) and
+        # legitimately shards over "pop")
+        pop_leading = any(
+            l.ndim >= 1 and l.shape[0] >= pop and l.shape[0] % pop == 0
+            for l in field_leaves
+        )
+        if dataclasses.is_dataclass(value):
+            # nested state: its own fields are checked by the recursion;
+            # the outer field needs no (single) annotation
+            continue
+        if pop_leading:
+            if spec != P(POP_AXIS):
+                errors.append(
+                    f"{where}.{path}: population-leading "
+                    f"(shape {field_leaves[0].shape}) but annotated {spec!r}; "
+                    f"expected field(sharding=P(POP_AXIS))"
+                )
+        else:
+            if spec != P():
+                errors.append(
+                    f"{where}.{path}: annotated {spec!r}; expected "
+                    "field(sharding=P()) for non-population fields"
+                )
+    assert not errors, "\n".join(errors)
+
+
+# algorithms the pool genuinely cannot build (need sub-algorithms, meta
+# params, or divisibility constraints the pool's POP breaks); every OTHER
+# registered algorithm must be covered — see test_coverage_baseline
+KNOWN_UNCONSTRUCTIBLE = {
+    "Coevolution",  # container: needs a base algorithm
+    "ClusteredAlgorithm",  # container: needs a base algorithm
+    "TreeAlgorithm",  # container: needs per-node algorithms
+    "RandomMaskAlgorithm",  # container: needs a base algorithm
+    "VectorizedCoevolution",  # container: needs a base algorithm
+    "DMSPSOEL",  # pop_size must be divisible by sub_swarm_size=10
+    "RestartCMAESDriver",  # host driver, not an Algorithm
+}
+
+
+def _constructible():
+    out = {}
+    for name, cls in _algorithm_classes().items():
+        algo = _construct(cls, name)
+        if algo is not None:
+            out[name] = algo
+    return out
+
+
+def test_coverage_baseline():
+    """The pool must keep covering at least the current surface: a new
+    registered algorithm either constructs from the pool or is explicitly
+    listed as unconstructible (forcing a conscious decision)."""
+    classes = _algorithm_classes()
+    built = set(_constructible())
+    missed = set(classes) - built - KNOWN_UNCONSTRUCTIBLE
+    assert not missed, (
+        f"registered algorithms neither constructible from the ARG_POOL "
+        f"nor listed in KNOWN_UNCONSTRUCTIBLE: {sorted(missed)}"
+    )
+    stale = {
+        n for n in KNOWN_UNCONSTRUCTIBLE if n in built
+    }
+    assert not stale, f"KNOWN_UNCONSTRUCTIBLE entries now constructible: {sorted(stale)}"
+
+
+@pytest.mark.parametrize("name", sorted(_constructible()))
+def test_algorithm_state_contract(name):
+    algo = _constructible()[name]
+    state = algo.init(jax.random.PRNGKey(0))
+    # some algorithms normalize pop_size in __init__ (MOEA/D's K*S grid,
+    # ESMC's odd-size rule): detect against the size they actually use
+    _check_state(state, name, pop=int(getattr(algo, "pop_size", POP)))
+
+
+def test_guarded_wrapper_state_contract():
+    """GuardedState itself (and its nested inner state) follows the
+    convention — the wrapper must not break mesh layouts."""
+    from evox_tpu.algorithms import CMAES
+
+    algo = GuardedAlgorithm(
+        CMAES(center_init=jnp.full((DIM,), 1.0), init_stdev=1.0, pop_size=POP)
+    )
+    state = algo.init(jax.random.PRNGKey(0))
+    _check_state(state, "GuardedAlgorithm[CMAES]")
+
+
+def test_monitor_state_contracts():
+    """Monitor states: frozen pytree dataclasses, all fields P() (their
+    buffers are capacity-leading, not population-leading)."""
+    from evox_tpu.monitors import EvalMonitor, TelemetryMonitor
+
+    for mon in (TelemetryMonitor(capacity=4), EvalMonitor()):
+        mstate = mon.init(jax.random.PRNGKey(0))
+        if mstate is None:  # pragma: no cover
+            continue
+        assert dataclasses.is_dataclass(mstate), type(mon).__name__
+        assert type(mstate).__dataclass_params__.frozen
+        for path, f, value in _iter_state_fields(mstate):
+            if f.metadata.get("static", False):
+                continue
+            spec = f.metadata.get("sharding")
+            assert spec == P(), (
+                f"{type(mon).__name__}.{path}: annotated {spec!r}; monitor "
+                "state fields must be field(sharding=P())"
+            )

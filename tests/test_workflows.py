@@ -124,17 +124,20 @@ def test_device_history_ring_buffer():
     assert all(h.shape == (8,) for h in hist)
     sols = mon.get_device_solution_history(ms)
     assert len(sols) == 3 and sols[0].shape == (8, 2)
-    # chronological: the last entry is the newest generation — its best
-    # should be <= the oldest retained generation's best (PSO improves)
-    assert float(jnp.min(hist[-1])) <= float(jnp.min(hist[0])) + 1e-6
-    # history parity with the callback-based recorder on this backend
+    # full-window parity with the callback-based recorder on this backend:
+    # the ring's 3 retained entries must be generations 3..5 in order,
+    # element-exact. (A previous version asserted per-generation best
+    # fitness decreases across the window — a flawed expectation: PSO's
+    # CANDIDATE batch is not elitist, so its per-generation best is not
+    # monotone; only pbest/gbest are. The ring was recording correctly.)
     mon2 = EvalMonitor(full_fit_history=True)
     wf2 = StdWorkflow(algo, Sphere(), monitors=[mon2])
     run_workflow(wf2, 5)
     host_hist = mon2.get_fitness_history()
-    np.testing.assert_allclose(
-        np.asarray(hist[-1]), np.asarray(host_hist[-1]), rtol=1e-6
-    )
+    for ring_gen, host_gen in zip(hist, host_hist[2:]):
+        np.testing.assert_allclose(
+            np.asarray(ring_gen), np.asarray(host_gen), rtol=1e-6
+        )
 
 
 def test_device_history_variable_batch_width():
