@@ -22,8 +22,11 @@ from .core import (
     create_mesh,
     POP_AXIS,
     DispatchRecorder,
+    RetraceError,
+    CostAnalyzer,
     instrument,
     run_report,
+    write_chrome_trace,
     write_report_jsonl,
 )
 from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
@@ -47,8 +50,11 @@ __all__ = [
     "create_mesh",
     "POP_AXIS",
     "DispatchRecorder",
+    "RetraceError",
+    "CostAnalyzer",
     "instrument",
     "run_report",
+    "write_chrome_trace",
     "write_report_jsonl",
     "StdWorkflow",
     "IslandWorkflow",

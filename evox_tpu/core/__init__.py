@@ -18,10 +18,13 @@ from .distributed import (
 )
 from .instrument import (
     DispatchRecorder,
+    RetraceError,
     instrument,
     run_report,
+    write_chrome_trace,
     write_report_jsonl,
 )
+from .xla_cost import CHIP_CEILINGS, CostAnalyzer
 from .guardrail import (
     GuardedAlgorithm,
     GuardedState,
@@ -36,8 +39,12 @@ __all__ = [
     "IPOPRestarts",
     "recenter_state",
     "DispatchRecorder",
+    "RetraceError",
+    "CHIP_CEILINGS",
+    "CostAnalyzer",
     "instrument",
     "run_report",
+    "write_chrome_trace",
     "write_report_jsonl",
     "PyTreeNode",
     "field",

@@ -19,9 +19,34 @@ steady-state dispatch statistics. Host fetches go through
 fetch site (a big-array fetch costs real tunnel time, ~6.6 s/256 MB —
 the reason bench.py fetches a small leaf).
 
+Beyond timing, the recorder is the host half of the roofline analytics
+layer (core/xla_cost.py):
+
+- **Work-normalized timing**: each call carries a work count (``run``'s
+  ``n_steps``; 1 elsewhere). When an entry was called at two distinct
+  trip counts, the per-generation time is the *differenced slope*
+  ``(t(n2) - t(n1)) / (n2 - n1)`` — bench.py's latency-cancelling
+  discipline — otherwise the steady median is used and flagged
+  ``latency_confounded`` (a single-trip-count timing still contains the
+  whole per-dispatch round-trip).
+- **Retrace detection**: every call's abstract argument signature is
+  recorded. A new *aval* signature (leaf shapes/dtypes changed) after an
+  entry's first call is the classic silent TPU perf killer — flagged in
+  the summary (``retrace_flags``) and escalated to :class:`RetraceError`
+  under ``DispatchRecorder(strict_retrace=True)``. Static-only structure
+  changes (e.g. the designed ``first_step`` peel recompile) are counted
+  separately and never flagged.
+- **Span recording**: every timed call and fetch keeps its
+  ``(start, duration)`` so :func:`write_chrome_trace` can export the run
+  as a Chrome trace-event JSON timeline (Perfetto / chrome://tracing),
+  with TelemetryMonitor rings and farm health counters as counter tracks.
+
 ``run_report`` merges this host-side summary with the device counters of
 any attached monitor exposing ``report(mstate)`` (TelemetryMonitor) into
-one JSON-serializable dict; ``write_report_jsonl`` appends it to a
+one JSON-serializable dict — plus, when a :class:`~evox_tpu.core.
+xla_cost.CostAnalyzer` is attached (``instrument(wf, analyze=True)``), a
+``roofline`` section attributing each entry point compute-bound /
+memory-bound / dispatch-bound; ``write_report_jsonl`` appends it to a
 JSON-lines file.
 """
 
@@ -31,16 +56,20 @@ import contextlib
 import json
 import math
 import time
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from .xla_cost import CostAnalyzer, abstract_signature, roofline_section
+
 __all__ = [
     "DispatchRecorder",
+    "RetraceError",
     "instrument",
     "run_report",
     "sanitize_json",
+    "write_chrome_trace",
     "write_report_jsonl",
 ]
 
@@ -70,11 +99,107 @@ DEFAULT_ENTRY_POINTS = (
 )
 
 
+class RetraceError(RuntimeError):
+    """An instrumented entry point is about to recompile because a call's
+    abstract argument signature (leaf shapes/dtypes) changed — raised
+    instead of silently paying the compile when
+    ``DispatchRecorder(strict_retrace=True)``."""
+
+
+def _run_work(args: tuple, kwargs: dict) -> int:
+    """Work units of a ``run(state, n_steps, ...)`` call. Restart/resume
+    drivers may run fewer generations than requested; n_steps is still the
+    honest per-call upper bound and exact for plain fused runs."""
+    n = kwargs.get("n_steps", args[1] if len(args) > 1 else 1)
+    try:
+        return max(int(n), 1)
+    except (TypeError, ValueError):
+        return 1
+
+
+DEFAULT_WORK_EXTRACTORS: Dict[str, Callable[[tuple, dict], int]] = {
+    "run": _run_work,
+}
+
+
 class _EntryStats:
-    __slots__ = ("times",)
+    __slots__ = ("times", "works", "spans", "sigs", "aval_sigs", "retraces")
 
     def __init__(self) -> None:
         self.times: list = []  # call durations, [0] is the cold call
+        self.works: list = []  # work units per call (run: n_steps)
+        self.spans: list = []  # (abs_start_s, duration_s, work)
+        self.sigs: Dict[str, int] = {}  # full (aval|static) sig -> calls
+        self.aval_sigs: Dict[str, int] = {}  # aval sig -> calls
+        self.retraces: list = []  # {"call", "kind", "t"} events
+
+    # ------------------------------------------------------------ retrace
+    def observe_signature(self, sig: Tuple[str, str], t: float) -> Optional[str]:
+        """Record a call's (aval, static) signature; returns the retrace
+        kind (``"aval"``/``"static"``) when this call will recompile an
+        already-compiled entry, else None. The FIRST signature is the
+        initial compile, never a retrace."""
+        aval, static = sig
+        full = aval + "|" + static
+        kind = None
+        if self.sigs and full not in self.sigs:
+            kind = "aval" if aval not in self.aval_sigs else "static"
+            self.retraces.append(
+                {"call": len(self.times) + 1, "kind": kind, "t": t}
+            )
+        self.sigs[full] = self.sigs.get(full, 0) + 1
+        self.aval_sigs[aval] = self.aval_sigs.get(aval, 0) + 1
+        return kind
+
+    @property
+    def aval_retraces(self) -> int:
+        return sum(1 for r in self.retraces if r["kind"] == "aval")
+
+    # ------------------------------------------------------------- timing
+    def _per_work(self) -> Optional[dict]:
+        """Seconds per work unit. Differenced slope over the two extreme
+        distinct work counts when available (per-dispatch latency cancels
+        exactly, bench.py's protocol); else the steady median divided by
+        its median work, flagged latency-confounded. The cold call (index
+        0, trace+compile) is excluded whenever warmer data exists."""
+        if not self.times:
+            return None
+        steady = (self.times[1:], self.works[1:]) if len(self.times) > 1 else None
+        for source, cold_included in ((steady, False), ((self.times, self.works), True)):
+            if source is None:
+                continue
+            times, works = source
+            best: Dict[int, float] = {}
+            for w, t in zip(works, times):
+                best[w] = min(t, best.get(w, math.inf))
+            if len(best) < 2:
+                continue
+            w1, w2 = min(best), max(best)
+            slope = (best[w2] - best[w1]) / (w2 - w1)
+            # noise (or a compile inside the smaller-work call) can invert
+            # the pair — fall through to the median rather than report it
+            if slope > 0:
+                out = {
+                    "seconds": round(slope, 9),
+                    "method": "differenced",
+                    "latency_confounded": False,
+                    "work_pair": [w1, w2],
+                }
+                if cold_included:
+                    # one end of the slope still contains trace+compile —
+                    # warm both trip counts (bench.py discipline) to clear
+                    out["cold_call_included"] = True
+                return out
+        times, works = (self.times, self.works) if steady is None else steady
+        med_t = float(np.median(times))
+        med_w = max(float(np.median(works)), 1.0)
+        return {
+            "seconds": round(med_t / med_w, 9),
+            "method": "median_per_work",
+            # a single trip count cannot cancel the per-dispatch
+            # round-trip: the rate below under-reports on the tunnel
+            "latency_confounded": True,
+        }
 
     def summary(self) -> dict:
         first = self.times[0]
@@ -83,6 +208,7 @@ class _EntryStats:
             "calls": len(self.times),
             "first_call_s": round(first, 6),
             "total_s": round(sum(self.times), 6),
+            "work_total": int(sum(self.works)),
         }
         if steady:
             p50 = float(np.percentile(steady, 50))
@@ -99,35 +225,127 @@ class _EntryStats:
         else:
             out["dispatch_s"] = None
             out["compile_s"] = round(first, 6)
+        out["per_work_s"] = self._per_work()
+        out["signatures"] = {
+            "aval": len(self.aval_sigs),
+            "static": len(self.sigs),
+            "retraces": len(self.retraces),
+            "aval_retraces": self.aval_retraces,
+            # static-only recompiles (e.g. the designed first_step peel)
+            # are recorded above but only AVAL changes flag: a new leaf
+            # shape/dtype is the silent perf killer
+            "flagged": self.aval_retraces > 0,
+        }
         return out
 
 
 class DispatchRecorder:
-    """Per-entry-point wall-clock registry; all accounting host-side."""
+    """Per-entry-point wall-clock registry; all accounting host-side.
 
-    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+    Args:
+        clock: monotonic seconds source (default ``time.perf_counter``).
+        strict_retrace: raise :class:`RetraceError` *before* dispatching a
+            call whose abstract argument signature (leaf shapes/dtypes)
+            would recompile an already-compiled entry point. Static-only
+            structure changes (the designed ``first_step`` peel) never
+            raise.
+        max_spans: cap on retained ``(start, duration)`` spans across all
+            entries+fetches (timeline export memory bound for very long
+            runs); beyond it spans are dropped (counted) while the
+            aggregate statistics keep accumulating.
+        block_dispatch: block on the returned pytree
+            (``jax.block_until_ready``) INSIDE the timed region. Default
+            off: a warm call's duration is then the host-side dispatch
+            cost (JAX async dispatch — the PR-1 semantics). Turn it ON
+            to measure roofline rates: the differenced per-work slope
+            needs durations that scale with the work, which async
+            dispatch times do not. Axon caveat (CLAUDE.md):
+            ``block_until_ready`` can return before the tunneled compute
+            ran, so on that backend the blocked timing under-measures —
+            end the measured region with a small :meth:`fetch` as
+            bench.py does and prefer its slope.
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.perf_counter,
+        strict_retrace: bool = False,
+        max_spans: int = 100_000,
+        block_dispatch: bool = False,
+    ):
         self._clock = clock
         self._entries: Dict[str, _EntryStats] = {}
         self._fetches: Dict[str, dict] = {}
+        self._fetch_spans: List[dict] = []
         self._created = clock()
+        self.strict_retrace = strict_retrace
+        self.max_spans = max_spans
+        self.block_dispatch = block_dispatch
+        self._span_count = 0
+        self._dropped_spans = 0
+        self.analyzer: Optional[CostAnalyzer] = None
+
+    def _keep_span(self) -> bool:
+        if self._span_count >= self.max_spans:
+            self._dropped_spans += 1
+            return False
+        self._span_count += 1
+        return True
 
     # ------------------------------------------------------------- recording
     @contextlib.contextmanager
-    def record(self, name: str):
-        """Time a host-side block as one call of entry point ``name``."""
+    def record(self, name: str, work: int = 1):
+        """Time a host-side block as one call of entry point ``name``
+        covering ``work`` units (generations) of progress."""
         t0 = self._clock()
         try:
             yield
         finally:
             dt = self._clock() - t0
-            self._entries.setdefault(name, _EntryStats()).times.append(dt)
+            stats = self._entries.setdefault(name, _EntryStats())
+            stats.times.append(dt)
+            stats.works.append(work)
+            if self._keep_span():
+                stats.spans.append((t0, dt, work))
 
-    def wrap(self, name: str, fn: Callable) -> Callable:
-        """Wrap ``fn`` so every call is recorded under ``name``."""
+    def wrap(
+        self,
+        name: str,
+        fn: Callable,
+        work_fn: Optional[Callable[[tuple, dict], int]] = None,
+    ) -> Callable:
+        """Wrap ``fn`` so every call is recorded under ``name``, with
+        signature tracking for retrace detection."""
 
         def wrapped(*args: Any, **kwargs: Any):
-            with self.record(name):
-                return fn(*args, **kwargs)
+            stats = self._entries.setdefault(name, _EntryStats())
+            sig = abstract_signature(args, kwargs)
+            # strict mode raises BEFORE the signature is recorded, so a
+            # retried call with the same bad shape raises again instead of
+            # silently passing a now-"known" signature to the compiler
+            if (
+                self.strict_retrace
+                and stats.sigs
+                and sig[0] not in stats.aval_sigs
+            ):
+                raise RetraceError(
+                    f"entry point '{name}' would retrace: abstract argument "
+                    f"signature changed to {sig[0][:200]} after "
+                    f"{len(stats.times)} call(s) — a leaf shape or dtype "
+                    "changed between calls (the classic silent TPU compile "
+                    "cost). Fix the shape instability, or drop "
+                    "strict_retrace to record it instead."
+                )
+            stats.observe_signature(sig, self._clock() - self._created)
+            work = work_fn(args, kwargs) if work_fn is not None else 1
+            with self.record(name, work=work):
+                out = fn(*args, **kwargs)
+                if self.block_dispatch:
+                    # jax.block_until_ready skips non-array leaves itself;
+                    # anything it raises is a REAL device execution error
+                    # and must propagate, not be timed as a fast success
+                    jax.block_until_ready(out)
+                return out
 
         wrapped._dispatch_recorder = self  # idempotence marker for attach
         wrapped.__wrapped__ = fn
@@ -150,7 +368,11 @@ class DispatchRecorder:
                 continue
             if getattr(fn, "_dispatch_recorder", None) is self:
                 continue
-            setattr(workflow, name, self.wrap(name, fn))
+            setattr(
+                workflow,
+                name,
+                self.wrap(name, fn, DEFAULT_WORK_EXTRACTORS.get(name)),
+            )
         return workflow
 
     def fetch(self, tree: Any, name: str = "fetch") -> Any:
@@ -174,11 +396,15 @@ class DispatchRecorder:
         agg["calls"] += 1
         agg["bytes"] += nbytes
         agg["seconds"] += dt
+        if self._keep_span():
+            self._fetch_spans.append(
+                {"name": name, "t0": t0, "dt": dt, "bytes": nbytes}
+            )
         return host
 
     # --------------------------------------------------------------- summary
     def summary(self) -> dict:
-        return {
+        out = {
             "entry_points": {
                 name: stats.summary()
                 for name, stats in sorted(self._entries.items())
@@ -192,25 +418,55 @@ class DispatchRecorder:
                 for name, agg in sorted(self._fetches.items())
             },
             "wall_s": round(self._clock() - self._created, 6),
+            "retrace_flags": sorted(
+                name
+                for name, stats in self._entries.items()
+                if stats.aval_retraces > 0
+            ),
         }
+        if self._dropped_spans:
+            out["dropped_spans"] = self._dropped_spans
+        return out
 
 
 def instrument(
     workflow: Any,
     recorder: Optional[DispatchRecorder] = None,
     entry_points: Sequence[str] = DEFAULT_ENTRY_POINTS,
+    analyze: bool = False,
+    strict_retrace: bool = False,
+    block_dispatch: bool = False,
 ) -> DispatchRecorder:
     """Attach (or create) a :class:`DispatchRecorder` to ``workflow``.
 
+    ``analyze=True`` additionally attaches a :class:`~evox_tpu.core.
+    xla_cost.CostAnalyzer`: the first ``run_report`` AOT-lowers and
+    compiles the workflow's advertised entry points once (host-side, no
+    callbacks) and the report gains a ``roofline`` section.
+    ``strict_retrace=True`` makes any aval-signature retrace of an
+    instrumented entry raise :class:`RetraceError` instead of silently
+    recompiling. ``block_dispatch=True`` makes timed calls wait for
+    their result (required for meaningful roofline rates — see
+    :class:`DispatchRecorder`).
+
     Usage::
 
-        rec = instrument(wf)
+        rec = instrument(wf, analyze=True, block_dispatch=True)
         state = wf.init(key)
-        state = wf.run(state, 100)
+        state = wf.run(state, 100)   # warm
+        state = wf.run(state, 300)   # second trip count -> differenced
         report = run_report(wf, state, recorder=rec)
     """
-    recorder = recorder if recorder is not None else DispatchRecorder()
+    recorder = recorder if recorder is not None else DispatchRecorder(
+        strict_retrace=strict_retrace, block_dispatch=block_dispatch
+    )
+    if strict_retrace:
+        recorder.strict_retrace = True
+    if block_dispatch:
+        recorder.block_dispatch = True
     recorder.attach(workflow, entry_points)
+    if analyze and recorder.analyzer is None:
+        recorder.analyzer = CostAnalyzer()
     return recorder
 
 
@@ -219,6 +475,7 @@ def run_report(
     state: Any = None,
     recorder: Optional[DispatchRecorder] = None,
     extra: Optional[dict] = None,
+    analyzer: Optional[CostAnalyzer] = None,
 ) -> dict:
     """Merge device telemetry and host dispatch timings into ONE
     JSON-serializable dict.
@@ -228,6 +485,13 @@ def run_report(
     of ``state.monitors``. Host side: ``recorder.summary()``. Either half
     may be absent — a report can cover a bare recorder or a bare
     workflow+state.
+
+    Roofline: when ``analyzer`` is given (or the recorder carries one —
+    ``instrument(wf, analyze=True)``), the workflow's entry points are
+    AOT-analyzed (cached; one compile per entry+signature) and merged
+    with the measured per-work timings into a ``roofline`` section (see
+    :func:`~evox_tpu.core.xla_cost.roofline_section`). With no analyzer
+    the report is exactly the pre-roofline shape — a no-op.
     """
     report: dict = {"schema": "evox_tpu.run_report/v1"}
     if state is not None and hasattr(state, "generation"):
@@ -248,8 +512,25 @@ def run_report(
         astate = getattr(state, "algo", None)
         if hasattr(algo, "health_report") and hasattr(astate, "restarts"):
             report["guardrail"] = algo.health_report(astate)
-    if recorder is not None:
-        report["dispatch"] = recorder.summary()
+    summary = recorder.summary() if recorder is not None else None
+    if summary is not None:
+        report["dispatch"] = summary
+    if analyzer is None and recorder is not None:
+        analyzer = recorder.analyzer
+    if analyzer is not None:
+        if workflow is not None and state is not None:
+            try:
+                analyzer.analyze_workflow(workflow, state)
+            except Exception as e:
+                # analysis must never sink the report it decorates:
+                # analyze_callable degrades per entry, but the workflow's
+                # analysis_targets itself (eval_shape, fit_shape hooks)
+                # can raise — keep telemetry/dispatch, note the loss
+                report["roofline"] = {"error": f"{type(e).__name__}: {e}"}
+        if "roofline" not in report and analyzer.analyses:
+            report["roofline"] = roofline_section(
+                analyzer.analyses, summary, analyzer.ceilings
+            )
     if extra:
         report["extra"] = dict(extra)
     return sanitize_json(report)
@@ -259,3 +540,161 @@ def write_report_jsonl(report: dict, path: str) -> None:
     """Append ``report`` as one strict-JSON line to a JSON-lines file."""
     with open(path, "a") as f:
         f.write(json.dumps(sanitize_json(report), allow_nan=False) + "\n")
+
+
+# ------------------------------------------------------------ chrome trace
+
+_US = 1e6  # trace-event timestamps are microseconds
+
+
+def _counter_events(
+    track: str, samples: Sequence[Tuple[float, Any]], pid: int
+) -> List[dict]:
+    """One ``ph: "C"`` event per finite sample; ``samples`` carry
+    already-relative timestamps in seconds."""
+    short = track.rsplit("/", 1)[-1]
+    events = []
+    for t, v in samples:
+        v = float(v)
+        if not math.isfinite(v) or not math.isfinite(t):
+            continue
+        events.append(
+            {
+                "ph": "C",
+                "name": track,
+                "pid": pid,
+                "ts": round(max(t, 0.0) * _US, 3),
+                "args": {short: v},
+            }
+        )
+    return events
+
+
+def write_chrome_trace(
+    path: str,
+    recorder: Optional[DispatchRecorder] = None,
+    workflow: Any = None,
+    state: Any = None,
+    extra_counters: Optional[Dict[str, Sequence[Tuple[float, Any]]]] = None,
+) -> dict:
+    """Export a run as Chrome trace-event JSON (open in Perfetto or
+    chrome://tracing) and return the trace dict.
+
+    - Recorder spans become complete (``ph: "X"``) slices: one thread per
+      entry point under the "host dispatch" process, fetches on their own
+      thread with byte counts in ``args``; retrace events appear as
+      instant markers on the entry's thread.
+    - TelemetryMonitor rings (any monitor on ``workflow`` exposing
+      ``counter_tracks(mstate)``) become counter (``ph: "C"``) tracks.
+      The rings are generation-indexed — the callback-free design has no
+      per-generation host timestamps — so samples are spread uniformly
+      across the recorder's observed span window (or 1 ms/generation
+      without a recorder): counter shapes are exact, their time axis is
+      approximate by construction.
+    - ``extra_counters`` maps track names to ``(timestamp, value)``
+      samples stamped with the recorder's clock (``time.perf_counter``),
+      e.g. :meth:`ProcessRolloutFarm.counter_tracks` worker-health
+      samples — these land at their true host times.
+
+    Entirely host-side (no callbacks, axon-safe): everything exported was
+    already recorded outside traced code.
+    """
+    events: List[dict] = []
+    t0 = recorder._created if recorder is not None else 0.0
+    t_end = t0
+
+    def meta(pid: int, name: str, tid: Optional[int] = None) -> dict:
+        e = {
+            "ph": "M",
+            "pid": pid,
+            "name": "process_name" if tid is None else "thread_name",
+            "args": {"name": name},
+        }
+        if tid is not None:
+            e["tid"] = tid
+        return e
+
+    if recorder is not None:
+        events.append(meta(0, "host dispatch"))
+        names = sorted(recorder._entries)
+        for tid, name in enumerate(names, start=1):
+            stats = recorder._entries[name]
+            events.append(meta(0, name, tid))
+            for start, dur, work in stats.spans:
+                t_end = max(t_end, start + dur)
+                ev = {
+                    "ph": "X",
+                    "name": name,
+                    "cat": "dispatch",
+                    "pid": 0,
+                    "tid": tid,
+                    "ts": round((start - t0) * _US, 3),
+                    "dur": round(dur * _US, 3),
+                }
+                if work != 1:
+                    ev["args"] = {"work": work}
+                events.append(ev)
+            for r in stats.retraces:
+                events.append(
+                    {
+                        "ph": "i",
+                        "name": f"retrace:{r['kind']}",
+                        "cat": "retrace",
+                        "pid": 0,
+                        "tid": tid,
+                        "ts": round(max(r["t"], 0.0) * _US, 3),
+                        "s": "t",
+                    }
+                )
+        if recorder._fetch_spans:
+            tid = len(names) + 1
+            events.append(meta(0, "fetch", tid))
+            for span in recorder._fetch_spans:
+                t_end = max(t_end, span["t0"] + span["dt"])
+                events.append(
+                    {
+                        "ph": "X",
+                        "name": span["name"],
+                        "cat": "fetch",
+                        "pid": 0,
+                        "tid": tid,
+                        "ts": round((span["t0"] - t0) * _US, 3),
+                        "dur": round(span["dt"] * _US, 3),
+                        "args": {"bytes": span["bytes"]},
+                    }
+                )
+
+    window_s = max(t_end - t0, 0.0)
+    if workflow is not None and state is not None:
+        events.append(meta(1, "device telemetry"))
+        for i, mon in enumerate(getattr(workflow, "monitors", ())):
+            tracks_fn = getattr(mon, "counter_tracks", None)
+            if tracks_fn is None:
+                continue
+            for track, samples in tracks_fn(state.monitors[i]).items():
+                if not samples:
+                    continue
+                gens = [g for g, _ in samples]
+                lo, hi = min(gens), max(gens)
+                span = max(hi - lo, 1)
+                scale = (window_s / span) if window_s > 0 else 1e-3
+                rel = [((g - lo) * scale, v) for g, v in samples]
+                events.extend(_counter_events(track, rel, pid=1))
+
+    if extra_counters:
+        events.append(meta(2, "host counters"))
+        for track, samples in extra_counters.items():
+            rel = [(t - t0, v) for t, v in samples]
+            events.extend(_counter_events(track, rel, pid=2))
+
+    trace = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "exporter": "evox_tpu.core.instrument.write_chrome_trace",
+            "time_origin": "DispatchRecorder creation",
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(trace, f, allow_nan=False)
+    return trace

@@ -18,7 +18,12 @@ from .rollout import (
     mountain_car_soa,
     pendulum_soa,
 )
-from .rollout_mlp import PlaneEnv, chain_walker_planes, fused_mlp_rollout
+from .rollout_mlp import (
+    PlaneEnv,
+    chain_walker_planes,
+    fused_mlp_rollout,
+    fused_rollout_analysis,
+)
 
 __all__ = [
     "packed_dominance",
@@ -32,4 +37,5 @@ __all__ = [
     "PlaneEnv",
     "chain_walker_planes",
     "fused_mlp_rollout",
+    "fused_rollout_analysis",
 ]

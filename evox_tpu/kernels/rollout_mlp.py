@@ -374,6 +374,66 @@ def _rollout_mlp_kernel(
     out_ref[...] = total.reshape(out_ref.shape)
 
 
+_VMEM_MARGIN = 8 * 1024 * 1024  # scratch/accumulator slack past residency
+_VMEM_CAP = 100 * 2**20  # stay under the chip's VMEM (v5e: 128 MiB)
+
+
+def _vmem_plan(weights, biases, tile: int) -> Tuple[int, int]:
+    """``(resident bytes per grid cell, vmem_limit_bytes)`` for the fused
+    kernel: one tile of every layer's weight/bias planes is VMEM-resident,
+    Pallas double-buffers the blocks across grid cells, and the Mosaic
+    scoped-vmem budget is raised to twice the residency plus margin
+    (capped below the chip's VMEM). The single source of truth for both
+    the ``pallas_call`` compiler params and
+    :func:`fused_rollout_analysis`'s headroom report."""
+    w_item = weights[0].dtype.itemsize
+    per_cell = sum(
+        w.shape[0] * w.shape[1] * tile * w_item for w in weights
+    ) + sum(b.shape[0] * tile * w_item for b in biases)
+    return per_cell, min(2 * per_cell + _VMEM_MARGIN, _VMEM_CAP)
+
+
+def fused_rollout_analysis(
+    weights: Tuple[jax.Array, ...],
+    biases: Tuple[jax.Array, ...],
+    tile: int = _LANES,
+    weight_dtype: Any = None,
+) -> dict:
+    """Static VMEM-residency report for :func:`fused_mlp_rollout` — the
+    kernel half of the roofline analytics layer (core/xla_cost.py covers
+    the XLA-visible FLOPs/bytes; Mosaic's VMEM budget is invisible to
+    HLO cost analysis, so it is accounted here from the same arithmetic
+    the kernel's ``CompilerParams`` uses).
+
+    Pure host-side arithmetic on shapes/dtypes (no compile, no callbacks
+    — axon-safe): the per-grid-cell resident weight/bias bytes, the
+    double-buffered requirement, the ``vmem_limit_bytes`` the kernel
+    will request, and the headroom between them. Negative headroom means
+    the cap clipped the request — the compile will fail or thrash; shrink
+    ``tile`` or narrow ``weight_dtype`` (bf16 halves residency, the
+    knob PERF_NOTES §9 documents)."""
+    if weight_dtype is not None:
+        itemsize = jnp.dtype(weight_dtype).itemsize
+        scale = itemsize / weights[0].dtype.itemsize
+    else:
+        scale = 1.0
+    per_cell, limit = _vmem_plan(weights, biases, tile)
+    per_cell = int(per_cell * scale)
+    limit = min(2 * per_cell + _VMEM_MARGIN, _VMEM_CAP)
+    return {
+        "tile": tile,
+        "weight_dtype": str(
+            jnp.dtype(weight_dtype) if weight_dtype is not None
+            else weights[0].dtype
+        ),
+        "resident_bytes_per_cell": per_cell,
+        "double_buffered_bytes": 2 * per_cell,
+        "vmem_limit_bytes": limit,
+        "vmem_cap_bytes": _VMEM_CAP,
+        "headroom_bytes": limit - 2 * per_cell,
+    }
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -501,12 +561,9 @@ def fused_mlp_rollout(
         # weights — raise it (v5e VMEM is far larger than the default cap)
         from jax.experimental.pallas import tpu as pltpu
 
-        w_item = weights[0].dtype.itemsize
-        per_cell = sum(
-            w.shape[0] * w.shape[1] * tile * w_item for w in weights
-        ) + sum(b.shape[0] * tile * w_item for b in biases)
+        _, vmem_limit = _vmem_plan(weights, biases, tile)
         kwargs["compiler_params"] = pltpu.CompilerParams(
-            vmem_limit_bytes=min(2 * per_cell + 8 * 1024 * 1024, 100 * 2**20)
+            vmem_limit_bytes=vmem_limit
         )
     out_dtype = jnp.float32  # the documented reward-sum contract
     total = pl.pallas_call(

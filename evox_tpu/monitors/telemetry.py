@@ -260,6 +260,37 @@ class TelemetryMonitor(Monitor):
             "diversity": [float(div[s]) for s in slots],
         }
 
+    def counter_tracks(self, mstate: TelemetryState) -> dict:
+        """Generation-indexed counter samples for the Chrome-trace
+        exporter (:func:`evox_tpu.core.instrument.write_chrome_trace`):
+        ``{track_name: [(generation, value), ...]}``. Per-generation
+        tracks come from the on-device rings (best/mean fitness,
+        diversity — the last ``min(generations, capacity)`` generations);
+        cumulative counters without a ring (stagnation, restarts, NaN
+        fitness elements) contribute their final value as a single sample
+        at the last generation. Non-finite samples are the exporter's
+        problem (it skips them) — this stays a faithful read-back."""
+        traj = self.get_trajectory(mstate)
+        gens = traj["generation"]
+        tracks: dict = {}
+        if self.num_objectives == 1:
+            tracks["telemetry/best_fitness"] = list(zip(gens, traj["best"]))
+            tracks["telemetry/mean_fitness"] = list(zip(gens, traj["mean"]))
+        else:
+            for j in range(self.num_objectives):
+                tracks[f"telemetry/best_obj{j}"] = [
+                    (g, row[j]) for g, row in zip(gens, traj["best"])
+                ]
+        tracks["telemetry/diversity"] = list(zip(gens, traj["diversity"]))
+        last = int(mstate.generations)
+        for name, v in (
+            ("stagnation", mstate.stagnation),
+            ("restarts", mstate.restarts),
+            ("nan_fitness", mstate.nan_fitness),
+        ):
+            tracks[f"telemetry/{name}"] = [(last, int(v))]
+        return tracks
+
     def report(self, mstate: TelemetryState) -> dict:
         """One strictly JSON-serializable dict of every device counter
         plus the ring trajectory (non-finite values → ``None``) — the

@@ -370,6 +370,18 @@ class ProcessRolloutFarm(Problem):
         # and drains the leftovers instead of pruning them
         self._dirty: set = set()
         self._seed_rng = np.random.default_rng()
+        # worker-health accounting for observability (core/instrument.py's
+        # Chrome-trace counter tracks and run reports): cumulative host
+        # counters plus one (perf_counter, alive, dropped, redispatched)
+        # sample per completed generation — pure host bookkeeping, zero
+        # effect on the dispatch protocol
+        self.health = {
+            "generations": 0,
+            "workers_dropped": 0,
+            "slices_redispatched": 0,
+            "heartbeats": 0,
+        }
+        self._health_samples: list = []
         # cached setup payload: re-admitted (replacement) workers get the
         # exact bytes the original cohort got
         self._setup_msg = {
@@ -467,6 +479,7 @@ class ProcessRolloutFarm(Problem):
         All pings go out first and the pongs are drained in ONE select
         loop under per-worker deadlines, so N unresponsive workers cost
         one shared ``heartbeat_timeout``, not N serial ones."""
+        self.health["heartbeats"] += 1
         waiting: dict = {}  # conn -> pong deadline
         now = time.monotonic()
         for conn in list(self._conns):
@@ -520,6 +533,7 @@ class ProcessRolloutFarm(Problem):
         self._dirty.discard(conn)
         if conn in self._conns:
             self._conns.remove(conn)
+            self.health["workers_dropped"] += 1
 
     def shutdown(self) -> None:
         """Poison-pill every worker, then close all sockets."""
@@ -571,6 +585,15 @@ class ProcessRolloutFarm(Problem):
             for i, sp in enumerate(subpops)
         ]
         results = self._run_tasks(tasks)
+        self.health["generations"] += 1
+        self._health_samples.append(
+            (
+                time.perf_counter(),
+                len(self._conns),
+                self.health["workers_dropped"],
+                self.health["slices_redispatched"],
+            )
+        )
         rewards = [results[i]["rewards"] for i in range(n_slices)]
         mo = [results[i]["mo"] for i in range(n_slices)]
         if self.mo_keys:
@@ -707,6 +730,34 @@ class ProcessRolloutFarm(Problem):
             self.retry_backoff * (2 ** (attempts[i] - 1)), 2.0
         )
         pending.add(i)
+        self.health["slices_redispatched"] += 1
+
+    # -- observability ------------------------------------------------------
+    def health_report(self) -> dict:
+        """Cumulative worker-health counters plus the live membership —
+        host-side bookkeeping for run reports and dashboards; reading it
+        never touches the sockets."""
+        return {
+            "workers_alive": len(self._conns),
+            "num_workers": self.num_workers,
+            "min_workers": self.min_workers,
+            **self.health,
+        }
+
+    def counter_tracks(self) -> dict:
+        """Worker-health counter tracks for
+        :func:`evox_tpu.core.instrument.write_chrome_trace`'s
+        ``extra_counters``: ``{track: [(perf_counter_seconds, value),
+        ...]}``, one sample per completed generation. Timestamps share
+        the DispatchRecorder clock (``time.perf_counter``), so farm
+        health lands at its true host time on the exported timeline."""
+        return {
+            "farm/workers_alive": [(t, a) for t, a, _, _ in self._health_samples],
+            "farm/workers_dropped": [(t, d) for t, _, d, _ in self._health_samples],
+            "farm/slices_redispatched": [
+                (t, r) for t, _, _, r in self._health_samples
+            ],
+        }
 
     def _raise_degraded(self, pending, results, n_tasks) -> None:
         raise FarmDegradedError(

@@ -169,6 +169,21 @@ class IslandWorkflow:
         """Fused multi-generation run (see :meth:`StdWorkflow.run`)."""
         return fused_run(self, state, n_steps)
 
+    def analysis_targets(self, state: IslandWorkflowState) -> dict:
+        """AOT cost/memory analysis targets (see
+        :meth:`StdWorkflow.analysis_targets`): the steady jitted step and
+        the fused run loop (whose dynamic-trip-count body is counted once
+        by XLA, i.e. per generation). External problems are skipped —
+        their callback step is untraceable on the axon backend and the
+        island model has no pipelined halves."""
+        if not self.jit_step or self.external:
+            return {}
+        steady = state.replace(first_step=False) if state.first_step else state
+        return {
+            "step": (self._step, (steady,)),
+            "run": (self._run_loop, (steady, jnp.asarray(1, jnp.int32))),
+        }
+
     def best(self, state: IslandWorkflowState) -> Tuple[jax.Array, jax.Array]:
         """(island-stacked best fitness, global best) in the USER
         convention (same as the monitors report: a maximization run's

@@ -58,6 +58,16 @@ def run_host_pipelined(
     per-generation seeds from its own RNG (the rollout farms) re-seeds
     fresh after a resume — resume bit-equivalence holds for host problems
     whose evaluate is deterministic (see GUIDE.md §6).
+
+    Observability: ``instrument(wf)`` covers this loop — it wraps
+    ``wf.pipeline_ask``/``wf.pipeline_tell``, which this driver calls
+    through the workflow object, so per-half dispatch timings, retrace
+    flags, and (with ``analyze=True``) the AOT roofline of both jitted
+    halves land in ``run_report()`` exactly as for ``wf.run``; a
+    :class:`~evox_tpu.problems.neuroevolution.process_farm.
+    ProcessRolloutFarm` problem additionally contributes worker-health
+    counter tracks to ``write_chrome_trace(extra_counters=
+    farm.counter_tracks())``.
     """
     if not wf.external:
         raise ValueError(

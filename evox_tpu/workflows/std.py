@@ -224,6 +224,47 @@ class StdWorkflow:
         (workflows/ipop.py)."""
         return StdWorkflow(algorithm, **self._ctor_args)
 
+    def analysis_targets(self, state: "StdWorkflowState") -> dict:
+        """Entry-point programs for AOT cost/memory analysis
+        (core/xla_cost.py): ``{name: (jitted_callable, example_args)}``,
+        the exact compiled programs the workflow dispatches.
+
+        The steady state (``first_step=False``) is analyzed — that is
+        what every generation after the init peel runs, and what the
+        fused ``run`` loop carries. ``run``'s trip count is a traced
+        operand and XLA's cost analysis counts a dynamic-trip-count loop
+        body once, so its static FLOPs/bytes are PER GENERATION. For
+        external (host) problems the jitted step embeds a
+        ``pure_callback`` — untraceable on the axon backend — so the
+        pipelined halves (what ``run_host_pipelined`` actually
+        dispatches) are analyzed instead; the host ``evaluate`` between
+        them is outside XLA and outside this analysis by construction.
+        """
+        if not self.jit_step:
+            return {}
+        steady = state.replace(first_step=False) if state.first_step else state
+        if self.external:
+            cand_sds, ctx_sds = jax.eval_shape(self._p_ask, steady)
+            pop = jax.tree.leaves(cand_sds)[0].shape[0]
+            if self.num_objectives > 1:
+                fit_shape: Tuple[int, ...] = (pop, self.num_objectives)
+            else:
+                fit_shape = self.problem.fit_shape(pop)
+            fit_sds = jax.ShapeDtypeStruct(
+                fit_shape, jnp.dtype(self.problem.fit_dtype)
+            )
+            return {
+                "pipeline_ask": (self._p_ask, (steady,)),
+                "pipeline_tell": (
+                    self._p_tell,
+                    (steady, ctx_sds, fit_sds, steady.prob),
+                ),
+            }
+        return {
+            "step": (self._step, (steady,)),
+            "run": (self._run_loop, (steady, jnp.asarray(1, jnp.int32))),
+        }
+
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> StdWorkflowState:
         keys = jax.random.split(key, 2 + len(self.monitors))

@@ -1,0 +1,122 @@
+"""tools/check_report.py — the report-shape gate: run_report() and
+BENCH_*.json must stay valid against the schema validator, and the
+validator must actually catch the regressions it exists for (missing
+keys, non-strict JSON numbers)."""
+
+import importlib.util
+import json
+import pathlib
+
+import jax
+import jax.numpy as jnp
+
+from evox_tpu import StdWorkflow, instrument, run_report
+from evox_tpu.algorithms.so.es import CMAES
+from evox_tpu.monitors import TelemetryMonitor
+from evox_tpu.problems.numerical import Sphere
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location(
+    "check_report", REPO / "tools" / "check_report.py"
+)
+check_report = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_report)
+
+
+def _fresh_report(analyze):
+    tm = TelemetryMonitor(capacity=8)
+    wf = StdWorkflow(
+        CMAES(center_init=jnp.zeros(4), init_stdev=1.0, pop_size=8),
+        Sphere(),
+        monitors=(tm,),
+    )
+    rec = instrument(wf, analyze=analyze)
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.run(state, 4)
+    return run_report(wf, state, recorder=rec)
+
+
+def test_fresh_run_report_validates():
+    for analyze in (False, True):
+        report = _fresh_report(analyze)
+        assert check_report.validate_run_report(report) == [], analyze
+
+
+def test_validator_catches_regressions():
+    report = _fresh_report(True)
+    bad = json.loads(json.dumps(report))
+    del bad["schema"]
+    bad["dispatch"]["entry_points"]["step"]["calls"] = None
+    bad["roofline"]["entries"]["step"]["classification"] = "gpu-bound"
+    bad["telemetry"][0]["best_fitness"] = float("nan")
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "schema" in errors
+    assert "step.calls" in errors
+    assert "classification" in errors
+    assert "non-finite" in errors
+
+
+def test_bench_jsons_validate():
+    """Every BENCH_*.json the driver has captured must either validate as
+    a bench summary or be a truncated capture (some historical envelopes
+    keep only a cut stdout tail — r01/r05 — which the validator reports
+    as 'no bench summary line', never as a shape violation)."""
+    paths = sorted(REPO.glob("BENCH_r*.json"))
+    assert paths, "no BENCH_*.json captures found"
+    validated = 0
+    for path in paths:
+        errors = check_report.validate_file(str(path))
+        if errors == []:
+            validated += 1
+        else:
+            assert len(errors) == 1 and "no bench summary line" in errors[0], (
+                path.name, errors,
+            )
+    assert validated > 0, "no capture had an intact summary to validate"
+
+
+def test_validate_bench_on_fresh_summary_shape():
+    """The exact dict bench.py main() prints (with the PR-4 roofline
+    fields) passes; a leg with a non-numeric ratio round fails."""
+    leg = {
+        "metric": "CSO/Ackley evals/sec",
+        "value": 1.0e6,
+        "unit": "evals/sec",
+        "vs_baseline": 1.2,
+        "ratio_rounds": [1.1, 1.2, 1.3],
+        "flops_per_eval": 19456,
+        "bytes_per_eval": 24576,
+        "achieved_gflops": 19.4,
+        "achieved_gbps": 24.5,
+        "frac_peak_compute": 9.4e-5,
+        "frac_peak_bandwidth": 4.0e-5,
+    }
+    summary = {
+        "metric": "geomean speedup over reference (CSO/Ackley)",
+        "value": 1.2,
+        "unit": "x",
+        "vs_baseline": 1.2,
+        "sub_metrics": [leg],
+        "run_report": _fresh_report(True),
+    }
+    assert check_report.validate_bench(summary) == []
+    bad = json.loads(json.dumps(summary))
+    bad["sub_metrics"][0]["ratio_rounds"] = ["high"]
+    assert any(
+        "ratio_rounds" in e for e in check_report.validate_bench(bad)
+    )
+
+
+def test_validator_cli_detects_jsonl(tmp_path):
+    good = _fresh_report(False)
+    p = tmp_path / "runs.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write('{"schema": "evox_tpu.run_report/v1", "x": NaN}\n')
+    errors = check_report.validate_file(str(p))
+    assert len(errors) == 1 and "runs.jsonl:2" in errors[0]
+    assert check_report.main([str(p)]) == 1
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps(good) + "\n")
+    assert check_report.main([str(ok)]) == 0

@@ -1,0 +1,284 @@
+"""Schema validator for evox_tpu run reports and BENCH summary JSON.
+
+``run_report()`` (core/instrument.py) and bench.py's summary line are the
+two structured-JSON surfaces downstream tooling consumes (dashboards,
+the driver's BENCH_*.json diffs, jq pipelines). This validator pins their
+shape so a refactor that silently drops a key or leaks a bare
+``NaN``/``Infinity`` token (rejected by strict JSON parsers) fails a fast
+tier-1 test (tests/test_check_report.py) instead of a downstream
+pipeline.
+
+Usage::
+
+    python tools/check_report.py BENCH_r05.json runs.jsonl ...
+
+``.jsonl`` files are validated line by line as run reports; ``.json``
+files are sniffed: a top-level ``sub_metrics`` key means a bench summary,
+a ``schema`` key a run report, a ``traceEvents`` key a Chrome trace.
+Exit status 0 = every file valid, 1 = violations (printed one per line).
+
+The finiteness rule is exactly ``core.instrument.sanitize_json``'s: a
+value the sanitizer would rewrite (non-finite float) is a violation —
+report producers must sanitize before writing.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Iterator, List, Tuple
+
+RUN_REPORT_SCHEMA_PREFIX = "evox_tpu.run_report/"
+CLASSIFICATIONS = {"compute-bound", "memory-bound", "dispatch-bound", None}
+
+
+def _walk(obj: Any, path: str = "$") -> Iterator[Tuple[str, Any]]:
+    yield path, obj
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _walk(v, f"{path}.{k}")
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from _walk(v, f"{path}[{i}]")
+
+
+def find_nonfinite(obj: Any) -> List[str]:
+    """Paths of every value ``sanitize_json`` would rewrite — i.e. every
+    float that breaks RFC 8259 strict JSON."""
+    return [
+        path
+        for path, v in _walk(obj)
+        if isinstance(v, float) and not math.isfinite(v)
+    ]
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(report, dict):
+        return [f"{where}: not a JSON object"]
+    schema = report.get("schema")
+    if not isinstance(schema, str) or not schema.startswith(
+        RUN_REPORT_SCHEMA_PREFIX
+    ):
+        errors.append(
+            f"{where}: missing/unknown schema key (want "
+            f"'{RUN_REPORT_SCHEMA_PREFIX}*', got {schema!r})"
+        )
+    errors += [f"{where}: non-finite number at {p}" for p in find_nonfinite(report)]
+    for i, mon in enumerate(report.get("telemetry", []) or []):
+        if not isinstance(mon, dict) or "monitor" not in mon:
+            errors.append(f"{where}: telemetry[{i}] lacks a 'monitor' key")
+    dispatch = report.get("dispatch")
+    if dispatch is not None:
+        if not isinstance(dispatch, dict):
+            errors.append(f"{where}: dispatch is not an object")
+        else:
+            for name, stats in (dispatch.get("entry_points") or {}).items():
+                for key in ("calls", "first_call_s", "total_s"):
+                    if not _num(stats.get(key)):
+                        errors.append(
+                            f"{where}: dispatch.entry_points.{name}.{key} "
+                            "missing or non-numeric"
+                        )
+                if isinstance(stats.get("calls"), int) and stats["calls"] < 1:
+                    errors.append(
+                        f"{where}: dispatch.entry_points.{name}.calls < 1"
+                    )
+            if not isinstance(dispatch.get("wall_s"), (int, float)):
+                errors.append(f"{where}: dispatch.wall_s missing")
+    roofline = report.get("roofline")
+    if roofline is not None:
+        if not isinstance(roofline, dict):
+            errors.append(f"{where}: roofline is not an object")
+        elif set(roofline) == {"error"}:
+            # degraded form: analysis failed, run_report kept the rest of
+            # the report and recorded why — valid by design
+            if not isinstance(roofline["error"], str):
+                errors.append(f"{where}: roofline.error is not a string")
+        else:
+            ceilings = roofline.get("ceilings") or {}
+            for key in ("mxu_bf16_tflops", "hbm_gbps"):
+                if not _num(ceilings.get(key)):
+                    errors.append(
+                        f"{where}: roofline.ceilings.{key} missing — rates "
+                        "without their ceiling are uninterpretable"
+                    )
+            entries = roofline.get("entries")
+            if not isinstance(entries, dict) or not entries:
+                errors.append(f"{where}: roofline.entries missing or empty")
+            else:
+                for name, entry in entries.items():
+                    loc = f"{where}: roofline.entries.{name}"
+                    static = entry.get("static")
+                    if not isinstance(static, dict):
+                        errors.append(f"{loc}.static missing")
+                    elif "error" not in static:
+                        for key in ("flops", "bytes_accessed"):
+                            if static.get(key) is not None and not _num(
+                                static[key]
+                            ):
+                                errors.append(f"{loc}.static.{key} non-numeric")
+                    if entry.get("classification") not in CLASSIFICATIONS:
+                        errors.append(
+                            f"{loc}.classification "
+                            f"{entry.get('classification')!r} not in "
+                            f"{sorted(c for c in CLASSIFICATIONS if c)}"
+                        )
+    return errors
+
+
+def validate_bench(summary: Any, where: str = "bench") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(summary, dict):
+        return [f"{where}: not a JSON object"]
+    for key in ("metric", "value", "unit", "sub_metrics"):
+        if key not in summary:
+            errors.append(f"{where}: missing top-level key {key!r}")
+    errors += [f"{where}: non-finite number at {p}" for p in find_nonfinite(summary)]
+    for i, leg in enumerate(summary.get("sub_metrics", []) or []):
+        loc = f"{where}: sub_metrics[{i}]"
+        if not isinstance(leg, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        for key in ("metric", "value", "unit"):
+            if key not in leg:
+                errors.append(f"{loc} missing {key!r}")
+        if "value" in leg and not _num(leg["value"]):
+            errors.append(f"{loc}.value non-numeric")
+        vs = leg.get("vs_baseline")
+        if vs is not None and not _num(vs):
+            errors.append(f"{loc}.vs_baseline neither null nor numeric")
+        rounds = leg.get("ratio_rounds")
+        if rounds is not None and (
+            not isinstance(rounds, list) or not all(_num(r) for r in rounds)
+        ):
+            errors.append(f"{loc}.ratio_rounds neither null nor numeric list")
+    rr = summary.get("run_report")
+    if rr is not None:
+        errors += validate_run_report(rr, where=f"{where}: run_report")
+    return errors
+
+
+def validate_bench_envelope(env: dict, where: str = "bench-envelope") -> List[str]:
+    """BENCH_*.json as the driver captures it: ``{cmd, rc, n, parsed,
+    tail}``. The bench summary is ``parsed`` when the driver managed to
+    parse it, else the last ``tail`` stdout line with ``sub_metrics``."""
+    summary = env.get("parsed")
+    if not isinstance(summary, dict) or "sub_metrics" not in summary:
+        summary = None
+        for line in reversed((env.get("tail") or "").splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "sub_metrics" in obj:
+                summary = obj
+                break
+    if summary is None:
+        if env.get("rc") not in (0, None):
+            # the bench itself failed; the envelope faithfully records
+            # that — shape validation has nothing to say
+            return []
+        return [f"{where}: no bench summary line found in parsed/tail"]
+    return validate_bench(summary, where=where)
+
+
+def validate_chrome_trace(trace: Any, where: str = "trace") -> List[str]:
+    errors: List[str] = []
+    if not isinstance(trace, dict) or not isinstance(
+        trace.get("traceEvents"), list
+    ):
+        return [f"{where}: no traceEvents array"]
+    errors += [f"{where}: non-finite number at {p}" for p in find_nonfinite(trace)]
+    counters_last_ts: dict = {}
+    for i, ev in enumerate(trace["traceEvents"]):
+        loc = f"{where}: traceEvents[{i}]"
+        ph = ev.get("ph")
+        if ph not in {"X", "B", "E", "C", "M", "i", "I"}:
+            errors.append(f"{loc}: unknown phase {ph!r}")
+            continue
+        if ph == "M":
+            continue
+        if not _num(ev.get("ts")) or ev["ts"] < 0:
+            errors.append(f"{loc}: ts missing/negative")
+            continue
+        if ph == "X" and (not _num(ev.get("dur")) or ev["dur"] < 0):
+            errors.append(f"{loc}: X event dur missing/negative")
+        if ph == "C":
+            key = (ev.get("pid"), ev.get("name"))
+            if ev["ts"] < counters_last_ts.get(key, float("-inf")):
+                errors.append(
+                    f"{loc}: counter track {ev.get('name')!r} ts not "
+                    "monotonic"
+                )
+            counters_last_ts[key] = ev["ts"]
+    return errors
+
+
+def validate_file(path: str) -> List[str]:
+    if path.endswith(".jsonl"):
+        errors: List[str] = []
+        with open(path) as f:
+            for lineno, line in enumerate(f, start=1):
+                if not line.strip():
+                    continue
+                try:
+                    # strict: bare NaN/Infinity tokens must fail, exactly
+                    # as they would in jq / JSON.parse
+                    obj = json.loads(
+                        line, parse_constant=lambda c: (_ for _ in ()).throw(
+                            ValueError(f"non-strict JSON constant {c}")
+                        )
+                    )
+                except ValueError as e:
+                    errors.append(f"{path}:{lineno}: {e}")
+                    continue
+                errors += [
+                    f"{path}:{lineno}: {e}"
+                    for e in validate_run_report(obj, where="run_report")
+                ]
+        return errors
+    with open(path) as f:
+        try:
+            obj = json.load(f)
+        except ValueError as e:
+            return [f"{path}: invalid JSON: {e}"]
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        errors = validate_chrome_trace(obj)
+    elif isinstance(obj, dict) and "sub_metrics" in obj:
+        errors = validate_bench(obj)
+    elif isinstance(obj, dict) and "tail" in obj and "cmd" in obj:
+        # driver envelope around a bench run ({cmd, rc, tail, ...}): the
+        # summary is the last stdout line carrying sub_metrics
+        errors = validate_bench_envelope(obj)
+    else:
+        errors = validate_run_report(obj)
+    return [f"{path}: {e}" for e in errors]
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    failed = False
+    for path in argv:
+        errors = validate_file(path)
+        if errors:
+            failed = True
+            for e in errors:
+                print(e)
+        else:
+            print(f"{path}: OK")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
