@@ -435,7 +435,13 @@ TEL_GENS = 30
 
 
 def telemetry_report(trace_path=None):
-    from evox_tpu import StdWorkflow, instrument, run_report, write_chrome_trace
+    from evox_tpu import (
+        RunSupervisor,
+        StdWorkflow,
+        instrument,
+        run_report,
+        write_chrome_trace,
+    )
     from evox_tpu.algorithms.so.pso import PSO
     from evox_tpu.monitors import TelemetryMonitor
     from evox_tpu.problems.numerical import Ackley
@@ -456,17 +462,24 @@ def telemetry_report(trace_path=None):
     # below bounds the total either way, and the timed legs' own slopes
     # remain the authoritative throughput numbers)
     rec = instrument(wf, analyze=True, block_dispatch=True)
+    # PR-5 supervision: a generous 10-minute deadline per dispatch (the
+    # cold dispatch below pays trace+compile+tunnel; a healthy run never
+    # comes near it) and bounded transient retry — on a flaky tunnel the
+    # sample heals instead of killing the bench, and the report's
+    # `supervisor` section records whatever the ladder did (outcome
+    # "clean" on a healthy backend)
+    sup = RunSupervisor(deadline_s=600.0, max_retries=2)
     state = wf.init(jax.random.PRNGKey(11))
-    state = wf.run(state, TEL_GENS)  # one fused dispatch (cold: compile)
-    state = wf.run(state, TEL_GENS)  # warm dispatch for the steady sample
+    state = sup.run(wf, state, TEL_GENS)  # one fused dispatch (cold: compile)
+    state = sup.run(wf, state, TEL_GENS)  # warm dispatch, steady sample
     # a SECOND, widely separated warm trip count gives the recorder a
     # differenced slope (t(10n)-t(n))/(9n) — per-generation time with the
     # per-dispatch latency cancelled, the same protocol the timed legs use
-    state = wf.run(state, 10 * TEL_GENS)
+    state = sup.run(wf, state, 10 * TEL_GENS)
     for _ in range(3):
         state = wf.step(state)  # per-step dispatch cost, warm
     rec.fetch(state.algo.gbest_fitness, name="gbest_fitness")
-    report = run_report(wf, state, recorder=rec)
+    report = run_report(wf, state, recorder=rec, supervisor=sup)
     if trace_path is not None:
         # Perfetto/chrome://tracing timeline of the instrumented sample:
         # dispatch/fetch spans + telemetry counter tracks

@@ -31,7 +31,11 @@ from .core import (
 )
 from . import algorithms, core, metrics, monitors, operators, problems, utils, vis_tools, workflows
 from .workflows import (
+    CheckpointConfigError,
+    DispatchDeadlineError,
     IslandWorkflow,
+    RunAbortedError,
+    RunSupervisor,
     StdWorkflow,
     WorkflowCheckpointer,
     run_host_pipelined,
@@ -59,6 +63,10 @@ __all__ = [
     "StdWorkflow",
     "IslandWorkflow",
     "WorkflowCheckpointer",
+    "CheckpointConfigError",
+    "RunSupervisor",
+    "RunAbortedError",
+    "DispatchDeadlineError",
     "run_host_pipelined",
     "algorithms",
     "core",

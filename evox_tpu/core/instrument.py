@@ -476,6 +476,7 @@ def run_report(
     recorder: Optional[DispatchRecorder] = None,
     extra: Optional[dict] = None,
     analyzer: Optional[CostAnalyzer] = None,
+    supervisor: Any = None,
 ) -> dict:
     """Merge device telemetry and host dispatch timings into ONE
     JSON-serializable dict.
@@ -492,6 +493,14 @@ def run_report(
     with the measured per-work timings into a ``roofline`` section (see
     :func:`~evox_tpu.core.xla_cost.roofline_section`). With no analyzer
     the report is exactly the pre-roofline shape — a no-op.
+
+    Supervisor: when ``supervisor`` is given — or the workflow was driven
+    by a :class:`~evox_tpu.workflows.supervisor.RunSupervisor`, which
+    advertises itself as ``workflow._run_supervisor`` — the report gains
+    a ``supervisor`` section (deadline/retry/restore/degradation events
+    and counters, ``RunSupervisor.report()``). Duck-typed: anything with
+    a zero-arg ``report()`` works, and core stays decoupled from the
+    workflows package.
     """
     report: dict = {"schema": "evox_tpu.run_report/v1"}
     if state is not None and hasattr(state, "generation"):
@@ -531,6 +540,10 @@ def run_report(
             report["roofline"] = roofline_section(
                 analyzer.analyses, summary, analyzer.ceilings
             )
+    if supervisor is None and workflow is not None:
+        supervisor = getattr(workflow, "_run_supervisor", None)
+    if supervisor is not None and hasattr(supervisor, "report"):
+        report["supervisor"] = supervisor.report()
     if extra:
         report["extra"] = dict(extra)
     return sanitize_json(report)
@@ -576,6 +589,7 @@ def write_chrome_trace(
     workflow: Any = None,
     state: Any = None,
     extra_counters: Optional[Dict[str, Sequence[Tuple[float, Any]]]] = None,
+    supervisor: Any = None,
 ) -> dict:
     """Export a run as Chrome trace-event JSON (open in Perfetto or
     chrome://tracing) and return the trace dict.
@@ -595,6 +609,14 @@ def write_chrome_trace(
       samples stamped with the recorder's clock (``time.perf_counter``),
       e.g. :meth:`ProcessRolloutFarm.counter_tracks` worker-health
       samples — these land at their true host times.
+    - Supervisor events (``supervisor=`` a :class:`~evox_tpu.workflows.
+      supervisor.RunSupervisor`, or picked up duck-typed from
+      ``workflow._run_supervisor``) become instant (``ph: "i"``) markers
+      — ``supervisor:retry`` / ``supervisor:deadline`` /
+      ``supervisor:restore`` / ``supervisor:degrade`` /
+      ``supervisor:abort`` — on their own "run supervisor" process at
+      their true host timestamps (same ``perf_counter`` clock as the
+      recorder).
 
     Entirely host-side (no callbacks, axon-safe): everything exported was
     already recorded outside traced code.
@@ -686,6 +708,26 @@ def write_chrome_trace(
         for track, samples in extra_counters.items():
             rel = [(t - t0, v) for t, v in samples]
             events.extend(_counter_events(track, rel, pid=2))
+
+    if supervisor is None and workflow is not None:
+        supervisor = getattr(workflow, "_run_supervisor", None)
+    if supervisor is not None and hasattr(supervisor, "markers"):
+        markers = supervisor.markers()
+        if markers:
+            events.append(meta(3, "run supervisor"))
+            for m in markers:
+                events.append(
+                    {
+                        "ph": "i",
+                        "name": m["name"],
+                        "cat": "supervisor",
+                        "pid": 3,
+                        "tid": 1,
+                        "ts": round(max(m["t_abs"] - t0, 0.0) * _US, 3),
+                        "s": "p",
+                        "args": sanitize_json(m.get("args", {})),
+                    }
+                )
 
     trace = {
         "traceEvents": events,

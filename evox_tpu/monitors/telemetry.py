@@ -291,6 +291,22 @@ class TelemetryMonitor(Monitor):
             tracks[f"telemetry/{name}"] = [(last, int(v))]
         return tracks
 
+    def fingerprint(self, mstate: TelemetryState) -> str:
+        """SHA-256 over the EXACT bytes of every telemetry field (rings
+        included) — a cheap host-side bit-identity witness. Two runs
+        whose fingerprints match produced byte-identical trajectories
+        and counters; the supervisor chaos law (tests/test_supervisor.py)
+        asserts a faulted-and-healed run fingerprints identically to the
+        clean run, and a post-mortem can cite the fingerprint as
+        evidence of how far a run got before aborting."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for path, leaf in jax.tree_util.tree_flatten_with_path(mstate)[0]:
+            h.update(jax.tree_util.keystr(path).encode())
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        return h.hexdigest()
+
     def report(self, mstate: TelemetryState) -> dict:
         """One strictly JSON-serializable dict of every device counter
         plus the ring trajectory (non-finite values → ``None``) — the

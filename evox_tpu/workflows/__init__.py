@@ -1,7 +1,17 @@
-from .checkpoint import WorkflowCheckpointer
+from .checkpoint import (
+    CheckpointConfigError,
+    WorkflowCheckpointer,
+    restore_layouts,
+)
 from .std import StdWorkflow, StdWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
 from .pipelined import run_host_pipelined
+from .supervisor import (
+    DispatchDeadlineError,
+    RunAbortedError,
+    RunSupervisor,
+    classify_error,
+)
 
 __all__ = [
     "StdWorkflow",
@@ -9,5 +19,11 @@ __all__ = [
     "IslandWorkflow",
     "IslandWorkflowState",
     "WorkflowCheckpointer",
+    "CheckpointConfigError",
+    "restore_layouts",
     "run_host_pipelined",
+    "RunSupervisor",
+    "RunAbortedError",
+    "DispatchDeadlineError",
+    "classify_error",
 ]

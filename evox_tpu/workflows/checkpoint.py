@@ -1,4 +1,4 @@
-"""Crash-safe, callback-free run checkpointing.
+"""Crash-safe, callback-free, topology-portable run checkpointing.
 
 :class:`~evox_tpu.monitors.CheckpointMonitor` auto-saves from INSIDE the
 jitted step via ``io_callback`` — which the tunneled axon TPU backend
@@ -10,16 +10,37 @@ directly-attached TPU, and the callback-less axon plugin.
 
 Durability contract:
 
-- Snapshots are written atomically (tmp + ``os.replace``), with a
-  digest-validated JSON manifest committed AFTER the data file — a crash
-  at any byte leaves either a complete (manifest + digest-verified data)
-  snapshot or an ignorable partial, never a torn restore.
+- Snapshots are written atomically (tmp + fsync + ``os.replace`` +
+  parent-directory fsync), with a digest-validated JSON manifest
+  committed AFTER the data file the same way — a crash (or power loss:
+  the directory fsync is what makes the rename itself durable, a rename
+  without it can tear) at any byte leaves either a complete (manifest +
+  digest-verified data) snapshot or an ignorable partial, never a torn
+  restore.
 - :meth:`WorkflowCheckpointer.latest` walks snapshots newest → oldest and
   skips (with a warning) anything whose manifest is missing/garbled or
   whose payload fails the SHA-256 check, restoring the newest snapshot
   that is provably intact.
-- The snapshot is the full workflow-state pytree with numpy leaves —
-  it drops straight back into ``wf.run`` / ``run_host_pipelined``.
+- Each manifest carries a **config fingerprint** of the snapshotted
+  state (leaf paths + shapes + dtypes + the algorithm state's type) —
+  ``latest(expect_like=...)`` / ``resume()`` refuse a snapshot written
+  under a different algorithm or population size
+  (:class:`CheckpointConfigError`) instead of feeding it to a compiled
+  program built for other shapes; ``allow_config_mismatch=True``
+  overrides.
+
+Topology portability: snapshot leaves are plain host numpy arrays
+(``jax.device_get`` gathers every shard), so a snapshot carries NO mesh
+— the manifest records the save-time topology and per-leaf sharding
+specs for provenance only. Restoring onto a *different* device count
+(the realistic TPU device-loss recovery path: checkpoint on 8 chips,
+restart on 4 or 1) is therefore data-complete by construction;
+:func:`restore_layouts` (or ``StdWorkflow.resume(state_sharding=...)``)
+eagerly re-places the host leaves onto the CURRENT mesh according to the
+state's own ``field(sharding=...)`` annotations — the same layout law
+``constrain_state`` applies inside the step, so the resumed run
+reproduces the straight run's remaining trajectory
+(tests/test_supervisor.py asserts 8→4→1 equivalence).
 
 Resume contract (asserted in tests/test_chaos.py): a run of ``n`` total
 generations that crashes after generation ``K`` and is resumed from the
@@ -45,6 +66,102 @@ from typing import Any, List, Optional
 import jax
 
 _SCHEMA = "evox_tpu.workflow_checkpoint/v1"
+
+
+class CheckpointConfigError(RuntimeError):
+    """A snapshot's config fingerprint does not match the run asking to
+    restore it — different algorithm, population size, monitors, or
+    state structure. Restoring it anyway would hand a compiled program
+    arrays of the wrong shape (or silently resurrect a different
+    experiment); pass ``allow_config_mismatch=True`` to override."""
+
+
+def state_config_fingerprint(state: Any) -> str:
+    """SHA-256 over the state's structural identity: every leaf's key
+    path, shape, and dtype, plus the algorithm state's type name.
+    Invariant across devices/meshes/backends AND across the host/device
+    boundary (a pickled-numpy snapshot fingerprints identically to the
+    live jax state it came from); sensitive to algorithm class,
+    population size, dimensionality, and monitor set. Static fields
+    (e.g. the ``first_step`` peel flag) are deliberately excluded — they
+    legitimately differ between a fresh state and a mid-run snapshot."""
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    parts = [type(getattr(state, "algo", state)).__name__]
+    for path, leaf in leaves:
+        arr = leaf if hasattr(leaf, "shape") else None
+        shape = tuple(arr.shape) if arr is not None else ()
+        dtype = str(arr.dtype) if arr is not None else type(leaf).__name__
+        parts.append(f"{jax.tree_util.keystr(path)}:{shape}:{dtype}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _fsync_path(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_durable(path: Path, payload: bytes, tmp_suffix: str) -> None:
+    """tmp + flush + fsync(file) + atomic rename + fsync(directory): the
+    full crash/power-loss discipline — an os.replace alone is atomic
+    against CRASHES but not durable against power loss until the parent
+    directory entry itself is synced."""
+    tmp = path.with_suffix(tmp_suffix)
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(path.parent)
+
+
+def _leaf_shardings(state: Any) -> dict:
+    """Per-leaf ``PartitionSpec`` strings of a LIVE (device) state, for
+    the manifest's provenance record. Host/numpy leaves record nothing."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is not None and any(s is not None for s in spec):
+            out[jax.tree_util.keystr(path)] = str(spec)
+    return out
+
+
+def restore_layouts(state: Any, mesh: Any = None, state_sharding: Any = None) -> Any:
+    """Eagerly place a host-restored snapshot onto the CURRENT mesh.
+
+    ``state_sharding``: an explicit pytree of shardings (e.g. from
+    :func:`~evox_tpu.core.distributed.state_sharding`) applied leaf-wise
+    with ``jax.device_put``. Without it, the state's own
+    ``field(sharding=...)`` annotations drive the placement on ``mesh``
+    (:func:`~evox_tpu.core.distributed.place_state`) — the same law
+    ``constrain_state`` applies inside every step, now on whatever mesh
+    the restoring process built. No-op when both are ``None`` (the first
+    dispatch then places leaves with its default device_put, and the
+    in-step constraints still land the declared layouts)."""
+    if state_sharding is not None:
+        return jax.tree.map(jax.device_put, state, state_sharding)
+    if mesh is None:
+        return state
+    from ..core.distributed import place_state
+
+    return place_state(state, mesh)
+
+
+def chunk_to_boundary(state: Any, checkpointer: Optional["WorkflowCheckpointer"],
+                      chunk: Optional[int] = None) -> int:
+    """Generations from ``state.generation`` to the next chunk boundary:
+    the checkpoint cadence grid when a checkpointer is given, else the
+    ``chunk`` grid, else effectively-unbounded (one dispatch for the
+    rest). Aligning chunks to a GLOBAL grid (not the entry generation)
+    keeps boundary generations identical across crash/resume/replay —
+    the same determinism law as ``checkpointed_run`` and ``ipop_run``."""
+    every = checkpointer.every if checkpointer is not None else chunk
+    if every is None:
+        return 1 << 30
+    return every - int(state.generation) % every
 
 
 class WorkflowCheckpointer:
@@ -80,40 +197,46 @@ class WorkflowCheckpointer:
         only names the DIRECTORY (``resume_from="ckpts/run"``) recreates
         the run's configured cadence instead of silently falling back to
         the defaults (and a weaker durability promise)."""
-        cpath = self.directory / self._CONFIG
-        tmp = cpath.with_suffix(".json.tmp")
-        with open(tmp, "w") as f:
-            json.dump({"every": self.every, "keep": self.keep}, f)
-        os.replace(tmp, cpath)
+        payload = json.dumps({"every": self.every, "keep": self.keep}).encode()
+        _write_durable(self.directory / self._CONFIG, payload, ".json.tmp")
 
     # ------------------------------------------------------------------ save
     def save(self, state: Any) -> Path:
         """Atomically snapshot ``state`` (blocking host-side pickle).
 
-        Writes ``ckpt_GGGGGGGG.pkl`` via tmp + rename, then its
-        ``.manifest.json`` (schema, generation, byte count, SHA-256) the
-        same way — the manifest is the commit record, so a torn data file
-        can never masquerade as a valid snapshot."""
+        Writes ``ckpt_GGGGGGGG.pkl`` via tmp + fsync + rename + directory
+        fsync, then its ``.manifest.json`` (schema, generation, byte
+        count, SHA-256, config fingerprint, save-time topology) the same
+        way — the manifest is the commit record, so a torn data file can
+        never masquerade as a valid snapshot."""
+        shardings = _leaf_shardings(state)
         host_state = jax.device_get(state)
         payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
         gen = int(host_state.generation)
         path = self.directory / f"ckpt_{gen:08d}.pkl"
-        tmp = path.with_suffix(".pkl.tmp")
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)
+        _write_durable(path, payload, ".pkl.tmp")
         manifest = {
             "schema": _SCHEMA,
             "generation": gen,
             "bytes": len(payload),
             "sha256": hashlib.sha256(payload).hexdigest(),
             "file": path.name,
+            # structural identity of the run (see state_config_fingerprint)
+            "config_sha": state_config_fingerprint(host_state),
+            # provenance only: the snapshot itself is topology-free host
+            # data; restore_layouts re-places it on whatever mesh the
+            # restoring process has
+            "save_topology": {
+                "device_count": jax.device_count(),
+                "process_count": jax.process_count(),
+                "leaf_shardings": shardings,
+            },
         }
-        mpath = self._manifest_path(path)
-        mtmp = mpath.with_suffix(".json.tmp")
-        with open(mtmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(mtmp, mpath)
+        _write_durable(
+            self._manifest_path(path),
+            json.dumps(manifest).encode(),
+            ".json.tmp",
+        )
         self._write_config()
         self._prune()
         return path
@@ -139,23 +262,56 @@ class WorkflowCheckpointer:
             for p in self.directory.glob("ckpt_????????.pkl.manifest.json")
         )
 
-    def latest(self) -> Optional[Any]:
+    def latest(
+        self,
+        expect_like: Any = None,
+        allow_config_mismatch: bool = False,
+    ) -> Optional[Any]:
         """Restore the newest intact snapshot (None when nothing usable).
 
         Corrupt or torn snapshots — missing/garbled manifest, size or
         SHA-256 mismatch, unpicklable payload — are skipped with a warning
         and the next-older snapshot is tried, so one bad file never takes
-        down a resume."""
+        down a resume.
+
+        ``expect_like``: a state pytree of the RESTORING run (a fresh
+        ``wf.init`` result, or the live state being resumed). A snapshot
+        whose recorded config fingerprint differs — different algorithm,
+        pop size, monitors — raises :class:`CheckpointConfigError`
+        instead of being silently restored into a program compiled for
+        other shapes (``allow_config_mismatch=True`` overrides; manifests
+        predating the fingerprint are never checked)."""
+        expected = (
+            None if expect_like is None
+            else state_config_fingerprint(expect_like)
+        )
         for path in reversed(self.snapshots()):
-            state = self._load_validated(path)
-            if state is not None:
-                return state
+            got = self._load_validated(path)
+            if got is None:
+                continue
+            manifest, state = got
+            recorded = manifest.get("config_sha")
+            if (
+                expected is not None
+                and recorded is not None
+                and recorded != expected
+                and not allow_config_mismatch
+            ):
+                raise CheckpointConfigError(
+                    f"checkpoint {path.name} was written under a different "
+                    f"run config (snapshot config_sha {recorded[:12]}… != "
+                    f"expected {expected[:12]}…): algorithm, population "
+                    "size, or monitor set changed. Rebuild the matching "
+                    "workflow, point at the right directory, or pass "
+                    "allow_config_mismatch=True to restore anyway."
+                )
+            return state
         return None
 
     def _manifest_path(self, path: Path) -> Path:
         return path.with_suffix(".pkl.manifest.json")
 
-    def _load_validated(self, path: Path) -> Optional[Any]:
+    def _load_validated(self, path: Path) -> Optional[tuple]:
         try:
             with open(self._manifest_path(path)) as f:
                 manifest = json.load(f)
@@ -167,7 +323,7 @@ class WorkflowCheckpointer:
             digest = hashlib.sha256(payload).hexdigest()
             if digest != manifest["sha256"]:
                 raise ValueError("sha256 mismatch")
-            return pickle.loads(payload)
+            return manifest, pickle.loads(payload)
         except Exception as e:
             warnings.warn(
                 f"skipping corrupt checkpoint {path.name}: {e}", stacklevel=2
@@ -199,15 +355,24 @@ def _as_checkpointer(resume_from: Any) -> WorkflowCheckpointer:
     return WorkflowCheckpointer(str(resume_from), **kw)
 
 
-def resolve_resume(resume_from: Any, state: Any, n_steps: int):
+def resolve_resume(
+    resume_from: Any,
+    state: Any,
+    n_steps: int,
+    expect_like: Any = None,
+    allow_config_mismatch: bool = False,
+):
     """Shared ``resume_from=`` handling for Std and pipelined runs.
 
     ``resume_from`` (a :class:`WorkflowCheckpointer` or a directory path)
     overrides ``state`` with its newest intact snapshot when one exists;
     ``n_steps`` then counts TOTAL generations from 0, so the remaining
-    trip count is ``n_steps - state.generation``. Returns
-    ``(state, remaining_steps)``."""
-    loaded = _as_checkpointer(resume_from).latest()
+    trip count is ``n_steps - state.generation``. ``expect_like``
+    (normally the caller's live ``state``) arms the config-fingerprint
+    guard. Returns ``(state, remaining_steps)``."""
+    loaded = _as_checkpointer(resume_from).latest(
+        expect_like=expect_like, allow_config_mismatch=allow_config_mismatch
+    )
     if loaded is not None:
         state = loaded
     return state, max(n_steps - int(state.generation), 0)
@@ -227,9 +392,7 @@ def checkpointed_run(wf, state, n_steps: int, checkpointer: WorkflowCheckpointer
     true end."""
     remaining = n_steps
     while remaining > 0:
-        gen = int(state.generation)
-        to_boundary = checkpointer.every - gen % checkpointer.every
-        chunk = min(remaining, to_boundary)
+        chunk = min(remaining, chunk_to_boundary(state, checkpointer))
         state = wf.run(state, chunk)
         remaining -= chunk
         if int(state.generation) % checkpointer.every == 0 or remaining == 0:

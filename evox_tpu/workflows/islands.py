@@ -165,8 +165,31 @@ class IslandWorkflow:
     def step(self, state: IslandWorkflowState) -> IslandWorkflowState:
         return self._step(state)
 
-    def run(self, state: IslandWorkflowState, n_steps: int) -> IslandWorkflowState:
-        """Fused multi-generation run (see :meth:`StdWorkflow.run`)."""
+    def run(
+        self,
+        state: IslandWorkflowState,
+        n_steps: int,
+        checkpointer: Any = None,
+        resume_from: Any = None,
+    ) -> IslandWorkflowState:
+        """Fused multi-generation run (see :meth:`StdWorkflow.run`).
+
+        ``checkpointer=`` / ``resume_from=`` give island runs the same
+        crash-safety law as :meth:`StdWorkflow.run` (chunk at the
+        cadence, snapshot between dispatches, resume to the TOTAL
+        generation target with the config-fingerprint guard armed) — and
+        make :class:`~evox_tpu.workflows.supervisor.RunSupervisor`'s
+        restore rung work for island runs too."""
+        from .checkpoint import _as_checkpointer, checkpointed_run, resolve_resume
+
+        if resume_from is not None:
+            state, n_steps = resolve_resume(
+                resume_from, state, n_steps, expect_like=state
+            )
+            if checkpointer is None:
+                checkpointer = _as_checkpointer(resume_from)
+        if checkpointer is not None:
+            return checkpointed_run(self, state, n_steps, checkpointer)
         return fused_run(self, state, n_steps)
 
     def analysis_targets(self, state: IslandWorkflowState) -> dict:

@@ -27,8 +27,39 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
 import jax
+import numpy as np
 
 from .checkpoint import WorkflowCheckpointer, _as_checkpointer, resolve_resume
+
+
+def chunked_evaluate(problem, pstate, cand, eval_chunk: Optional[int]):
+    """``problem.evaluate`` over row slices of at most ``eval_chunk``
+    candidates, fitness concatenated — the degradation the supervisor
+    applies when a full-batch host evaluation dies with OOM / HTTP 413
+    (CLAUDE.md: big tunneled payloads are the 413 trigger).
+
+    Bit-equivalence contract: chunking is invisible exactly when the
+    host ``evaluate`` scores rows independently of their batch (true for
+    deterministic per-candidate problems; NOT for farms that draw one
+    seed per evaluate() CALL — those re-seed per chunk, see GUIDE.md §6).
+    The problem state threads through the chunks in order and the LAST
+    chunk's returned state is kept, matching the unchunked call for
+    pass-through states."""
+    if eval_chunk is None:
+        return problem.evaluate(pstate, cand)
+    leaves = jax.tree.leaves(cand)
+    n = leaves[0].shape[0]
+    if eval_chunk < 1:
+        raise ValueError(f"eval_chunk must be >= 1, got {eval_chunk}")
+    if eval_chunk >= n:
+        return problem.evaluate(pstate, cand)
+    fits = []
+    for lo in range(0, n, eval_chunk):
+        hi = min(lo + eval_chunk, n)
+        part = jax.tree.map(lambda x: x[lo:hi], cand)
+        fit, pstate = problem.evaluate(pstate, part)
+        fits.append(np.asarray(fit))
+    return np.concatenate(fits, axis=0), pstate
 
 
 def run_host_pipelined(
@@ -39,6 +70,7 @@ def run_host_pipelined(
     checkpointer: Optional[WorkflowCheckpointer] = None,
     resume_from: Any = None,
     restarts: Any = None,
+    eval_chunk: Optional[int] = None,
 ):
     """Run ``n_steps`` generations of ``wf`` (a :class:`StdWorkflow` whose
     problem is external/host-side), overlapping host evaluation with
@@ -68,6 +100,13 @@ def run_host_pipelined(
     ProcessRolloutFarm` problem additionally contributes worker-health
     counter tracks to ``write_chrome_trace(extra_counters=
     farm.counter_tracks())``.
+
+    ``eval_chunk=``: evaluate the candidate batch in host-side row
+    slices of at most this many candidates (see :func:`chunked_evaluate`
+    for the bit-equivalence contract) — the payload-size degradation the
+    :class:`~evox_tpu.workflows.supervisor.RunSupervisor` halves on
+    OOM / HTTP 413, also usable directly to keep tunneled request sizes
+    bounded.
     """
     if not wf.external:
         raise ValueError(
@@ -86,13 +125,17 @@ def run_host_pipelined(
             n_steps,
             restarts,
             segment=lambda w, s, c, ck: run_host_pipelined(
-                w, s, c, on_generation=on_generation, checkpointer=ck
+                w, s, c, on_generation=on_generation, checkpointer=ck,
+                eval_chunk=eval_chunk,
             ),
             checkpointer=checkpointer,
             resume_from=resume_from,
         )
     if resume_from is not None:
-        state, n_steps = resolve_resume(resume_from, state, n_steps)
+        # expect_like=state: refuse a snapshot from a different config
+        state, n_steps = resolve_resume(
+            resume_from, state, n_steps, expect_like=state
+        )
         if checkpointer is None:
             # a resumed run must stay crash-safe (and must record its own
             # completion, or a second resume would re-run generations):
@@ -114,7 +157,9 @@ def run_host_pipelined(
     hook_pool = ThreadPoolExecutor(max_workers=1)
     try:
         cand, ctx = wf.pipeline_ask(state)
-        fut = eval_pool.submit(wf.problem.evaluate, state.prob, cand)
+        fut = eval_pool.submit(
+            chunked_evaluate, wf.problem, state.prob, cand, eval_chunk
+        )
         hook_fut = None
         for g in range(n_steps):
             fitness, _ = fut.result()
@@ -133,7 +178,9 @@ def run_host_pipelined(
                 # async dispatch: returns while the device still computes;
                 # the eval thread blocks on cand materialization, not us
                 cand, ctx = wf.pipeline_ask(state)
-                fut = eval_pool.submit(wf.problem.evaluate, state.prob, cand)
+                fut = eval_pool.submit(
+                    chunked_evaluate, wf.problem, state.prob, cand, eval_chunk
+                )
             if checkpointer is not None:
                 # between dispatches: the next eval is already in flight
                 # and the state is immutable, so the snapshot only costs

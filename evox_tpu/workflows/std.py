@@ -337,7 +337,11 @@ class StdWorkflow:
                 resume_from=resume_from,
             )
         if resume_from is not None:
-            state, n_steps = resolve_resume(resume_from, state, n_steps)
+            # expect_like=state arms the checkpoint config-fingerprint
+            # guard: the caller's live state IS the run's config
+            state, n_steps = resolve_resume(
+                resume_from, state, n_steps, expect_like=state
+            )
             if checkpointer is None:
                 # a resumed run stays crash-safe and records its own
                 # completion (else a second resume would re-run
@@ -352,14 +356,44 @@ class StdWorkflow:
         checkpointer: WorkflowCheckpointer,
         n_steps: int,
         fallback_state: Optional[StdWorkflowState] = None,
+        state_sharding: Any = None,
+        allow_config_mismatch: bool = False,
     ) -> StdWorkflowState:
         """Continue an interrupted checkpointed run to ``n_steps`` TOTAL
         generations: restore ``checkpointer``'s newest intact snapshot
         (falling back to ``fallback_state`` — e.g. a fresh ``wf.init`` —
         when no snapshot exists yet) and run the remaining generations
         with checkpointing still on. ``resume()`` of an already-complete
-        run returns its final snapshot unchanged."""
-        state = checkpointer.latest()
+        run returns its final snapshot unchanged.
+
+        Topology portability: snapshots hold mesh-free host arrays, so a
+        run checkpointed on one mesh resumes on THIS workflow's mesh —
+        however many devices it has (the device-loss recovery path:
+        checkpoint on 8 chips, restart on 4 or 1, keep the trajectory).
+        The restored leaves are eagerly re-placed by the state's own
+        ``field(sharding=...)`` annotations on ``self.mesh``
+        (:func:`~evox_tpu.workflows.checkpoint.restore_layouts`); pass
+        ``state_sharding=`` (a pytree of shardings, e.g. from
+        :func:`~evox_tpu.core.distributed.state_sharding`) to override
+        the placement explicitly.
+
+        Config guard: a snapshot written under a different algorithm /
+        population size / monitor set raises
+        :class:`~evox_tpu.workflows.checkpoint.CheckpointConfigError`
+        instead of restoring into a program compiled for other shapes;
+        ``allow_config_mismatch=True`` overrides."""
+        expect_like = fallback_state
+        if expect_like is None:
+            try:
+                # structure-only init: eval_shape never runs the program,
+                # so this is a cheap, key-independent config reference
+                expect_like = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+            except Exception:
+                expect_like = None  # exotic init: guard disarms, resume works
+        state = checkpointer.latest(
+            expect_like=expect_like,
+            allow_config_mismatch=allow_config_mismatch,
+        )
         if state is None:
             if fallback_state is None:
                 raise FileNotFoundError(
@@ -367,6 +401,12 @@ class StdWorkflow:
                     "pass fallback_state=wf.init(key) to start fresh"
                 )
             state = fallback_state
+        else:
+            from .checkpoint import restore_layouts
+
+            state = restore_layouts(
+                state, mesh=self.mesh, state_sharding=state_sharding
+            )
         return self.run(
             state,
             max(n_steps - int(state.generation), 0),

@@ -16,7 +16,7 @@ import tempfile
 import jax
 import jax.numpy as jnp
 
-from evox_tpu import StdWorkflow
+from evox_tpu import RunSupervisor, StdWorkflow, WorkflowCheckpointer
 from evox_tpu.algorithms.so.pso import PSO
 from evox_tpu.core import state_io
 from evox_tpu.core.distributed import create_mesh, place_state
@@ -47,6 +47,22 @@ def main():
     restored = restored.replace(algo=place_state(restored.algo, mesh))
     restored = wf.run(restored, 100)
     print("best after resume:", float(monitor.get_best_fitness(restored.monitors[0])))
+
+    # production shape (GUIDE.md §6): the same run SUPERVISED — per-chunk
+    # wall-clock deadlines, transient-RPC retry, and checkpoint replay; on
+    # a tunneled TPU a hung or dropped dispatch heals instead of killing
+    # the run. Snapshots are topology-portable: if this 8-device run dies,
+    # a 4- or 1-device process resumes it with
+    # wf.resume(WorkflowCheckpointer(ckpt_dir), n) on ITS mesh.
+    ckpt_dir = os.path.join(tempfile.mkdtemp(), "supervised")
+    sup = RunSupervisor(
+        checkpointer=WorkflowCheckpointer(ckpt_dir, every=25),
+        deadline_s=300.0,  # generous: a chunk pays compile + tunnel RTT
+        max_retries=3,
+    )
+    state = sup.run(wf, wf.init(jax.random.PRNGKey(1)), 100)
+    print("supervised best:", float(monitor.get_best_fitness(state.monitors[0])))
+    print("supervisor outcome:", sup.report()["outcome"])
 
 
 if __name__ == "__main__":

@@ -34,6 +34,16 @@ make every failure mode the farm/checkpointer must survive REPRODUCIBLE:
   flavor) that starve any improvement signal, the deterministic trigger
   for stagnation guards. Consumed by tests/test_numeric_chaos.py.
 
+- dispatch faults (PR 5): :class:`FlakyDispatch` wraps ANY callable at
+  the dispatch boundary (``wf.run``, ``problem.evaluate``, a pipelined
+  chunk) and injects the tunneled backend's failure modes — scripted
+  per call index, no real tunnel needed: ``"hang"`` (sleeps past any
+  deadline), ``"transient"`` (an ``UNAVAILABLE: connection reset``
+  RuntimeError, the message jaxlib's XlaRuntimeError carries),
+  ``"oom"`` (``RESOURCE_EXHAUSTED``), ``"http413"`` (payload too
+  large), ``"fatal"`` (an unclassifiable ValueError). Consumed by
+  tests/test_supervisor.py.
+
 Everything here is deterministic — no random fault timing — so the
 chaos tests assert exact outcomes (bit-identical fitness, pytree
 equality) rather than "usually survives".
@@ -156,6 +166,76 @@ def spawn_chaos_worker(
     )
     p.start()
     return p
+
+
+# --------------------------------------------------------------------------
+# dispatch-boundary fault injection (PR 5)
+
+
+def make_fault(kind: str) -> Exception:
+    """An exception whose type/message classifies exactly like the real
+    backend failure it mimics (see workflows/supervisor.py patterns)."""
+    if kind == "transient":
+        return RuntimeError(
+            "UNAVAILABLE: connection reset by peer (tunnel dropped)"
+        )
+    if kind == "oom":
+        return RuntimeError(
+            "RESOURCE_EXHAUSTED: out of memory allocating 268435456 bytes"
+        )
+    if kind == "http413":
+        return RuntimeError("remote_compile failed: HTTP 413 payload too large")
+    if kind == "fatal":
+        return ValueError("algorithm state is structurally broken")
+    raise ValueError(f"unknown fault kind: {kind!r}")
+
+
+class FlakyDispatch:
+    """Callable shim injecting dispatch-layer faults at the call boundary.
+
+    ``faults`` maps 0-based call indices to a fault kind (``"hang"`` /
+    ``"transient"`` / ``"oom"`` / ``"http413"`` / ``"fatal"``) or an
+    exception instance; unlisted calls delegate to ``fn``. ``trigger``
+    (optional) is consulted per call with ``(index, args, kwargs)`` and
+    may return a kind/exception too — e.g. "OOM whenever the evaluated
+    batch is wider than K" for degradation tests. Deterministic by
+    construction, so supervisor tests assert exact outcomes.
+
+    ``hang_s``: how long a "hang" blocks (a plain sleep on the abandoned
+    watchdog thread — keep it bounded so leaked daemon threads exit
+    before the suite does). ``calls`` counts every invocation,
+    ``served`` only the delegated ones.
+    """
+
+    def __init__(self, fn, faults=None, trigger=None, hang_s: float = 20.0):
+        self.fn = fn
+        self.faults = dict(faults or {})
+        self.trigger = trigger
+        self.hang_s = hang_s
+        self.calls = 0
+        self.served = 0
+
+    def _fault_for(self, index, args, kwargs):
+        fault = self.faults.get(index)
+        if fault is None and self.trigger is not None:
+            fault = self.trigger(index, args, kwargs)
+        return fault
+
+    def __call__(self, *args, **kwargs):
+        index = self.calls
+        self.calls += 1
+        fault = self._fault_for(index, args, kwargs)
+        if fault is not None:
+            if isinstance(fault, BaseException):
+                raise fault
+            if fault == "hang":
+                time.sleep(self.hang_s)
+                raise TimeoutError(
+                    "FlakyDispatch hang elapsed without a deadline firing"
+                )
+            raise make_fault(fault)
+        self.served += 1
+        return self.fn(*args, **kwargs)
 
 
 # --------------------------------------------------------------------------

@@ -196,3 +196,15 @@ def test_fault_tolerance_modules_are_callback_free():
     ):
         assert (PKG / rel).exists(), f"{rel} missing"
         assert rel not in users, f"{rel} must not use host callbacks"
+
+
+def test_supervisor_module_is_callback_free():
+    """The PR-5 run supervisor is pure host-side control flow — watchdog
+    threads, error classification, backoff sleeps, checkpoint replay —
+    wrapped AROUND dispatches. A host callback anywhere in it (or in the
+    checkpoint layer it replays through) would make supervised runs
+    unusable on the very backend whose failure modes it exists to heal."""
+    users = _scan()
+    for rel in ("workflows/supervisor.py", "workflows/checkpoint.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"

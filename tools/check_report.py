@@ -31,6 +31,16 @@ from typing import Any, Iterator, List, Tuple
 
 RUN_REPORT_SCHEMA_PREFIX = "evox_tpu.run_report/"
 CLASSIFICATIONS = {"compute-bound", "memory-bound", "dispatch-bound", None}
+SUPERVISOR_OUTCOMES = {"clean", "recovered", "aborted"}
+SUPERVISOR_EVENTS = {"retry", "deadline", "restore", "degrade", "abort"}
+SUPERVISOR_COUNTERS = (
+    "dispatches",
+    "retries",
+    "deadline_hits",
+    "restores",
+    "degradations",
+    "aborts",
+)
 
 
 def _walk(obj: Any, path: str = "$") -> Iterator[Tuple[str, Any]]:
@@ -91,6 +101,61 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                     )
             if not isinstance(dispatch.get("wall_s"), (int, float)):
                 errors.append(f"{where}: dispatch.wall_s missing")
+    sup = report.get("supervisor")
+    if sup is not None:
+        if not isinstance(sup, dict):
+            errors.append(f"{where}: supervisor is not an object")
+        else:
+            if sup.get("outcome") not in SUPERVISOR_OUTCOMES:
+                errors.append(
+                    f"{where}: supervisor.outcome {sup.get('outcome')!r} "
+                    f"not in {sorted(SUPERVISOR_OUTCOMES)}"
+                )
+            counters = sup.get("counters")
+            if not isinstance(counters, dict):
+                errors.append(f"{where}: supervisor.counters missing")
+            else:
+                for key in SUPERVISOR_COUNTERS:
+                    v = counters.get(key)
+                    if not isinstance(v, int) or v < 0:
+                        errors.append(
+                            f"{where}: supervisor.counters.{key} missing or "
+                            "not a non-negative int"
+                        )
+            events = sup.get("events")
+            if not isinstance(events, list):
+                errors.append(f"{where}: supervisor.events missing")
+            else:
+                last_t = float("-inf")
+                for i, ev in enumerate(events):
+                    loc = f"{where}: supervisor.events[{i}]"
+                    if not isinstance(ev, dict):
+                        errors.append(f"{loc} is not an object")
+                        continue
+                    if ev.get("event") not in SUPERVISOR_EVENTS:
+                        errors.append(
+                            f"{loc}.event {ev.get('event')!r} not in "
+                            f"{sorted(SUPERVISOR_EVENTS)}"
+                        )
+                    t = ev.get("t")
+                    if not _num(t) or t < 0:
+                        errors.append(f"{loc}.t missing/negative")
+                    elif t < last_t:
+                        errors.append(f"{loc}.t not monotonic")
+                    else:
+                        last_t = t
+                # a ladder that ended in abort must say so coherently
+                if (
+                    any(
+                        isinstance(ev, dict) and ev.get("event") == "abort"
+                        for ev in events
+                    )
+                    and sup.get("outcome") != "aborted"
+                ):
+                    errors.append(
+                        f"{where}: supervisor has an abort event but "
+                        f"outcome {sup.get('outcome')!r}"
+                    )
     roofline = report.get("roofline")
     if roofline is not None:
         if not isinstance(roofline, dict):
@@ -212,6 +277,20 @@ def validate_chrome_trace(trace: Any, where: str = "trace") -> List[str]:
             continue
         if ph == "X" and (not _num(ev.get("dur")) or ev["dur"] < 0):
             errors.append(f"{loc}: X event dur missing/negative")
+        if ev.get("cat") == "supervisor":
+            # supervisor decisions are POINTS in time, not spans — the
+            # exporter must emit them as instant markers
+            if ph not in {"i", "I"}:
+                errors.append(
+                    f"{loc}: supervisor event {ev.get('name')!r} must be an "
+                    f"instant marker (ph 'i'), got ph {ph!r}"
+                )
+            name = ev.get("name") or ""
+            if not str(name).startswith("supervisor:"):
+                errors.append(
+                    f"{loc}: supervisor marker name {name!r} must start "
+                    "with 'supervisor:'"
+                )
         if ph == "C":
             key = (ev.get("pid"), ev.get("name"))
             if ev["ts"] < counters_last_ts.get(key, float("-inf")):
