@@ -11,6 +11,8 @@ __version__ = "0.1.0"
 
 from .core import (
     Algorithm,
+    BF16_STORAGE,
+    DtypePolicy,
     GuardedAlgorithm,
     IPOPRestarts,
     Problem,
@@ -43,6 +45,8 @@ from .workflows import (
 
 __all__ = [
     "Algorithm",
+    "BF16_STORAGE",
+    "DtypePolicy",
     "GuardedAlgorithm",
     "IPOPRestarts",
     "Problem",

@@ -99,15 +99,15 @@ def pc_selection(
 
 
 class BCEIBEAState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))  # PC archive (the algorithm's output)
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    npc: jax.Array = field(sharding=P(POP_AXIS))  # NPC (IBEA) population
-    npc_fit: jax.Array = field(sharding=P(POP_AXIS))
-    new_pc: jax.Array = field(sharding=P(POP_AXIS))  # PC-exploration offspring awaiting the even phase
-    new_pc_fit: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # PC archive (the algorithm's output)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    npc: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # NPC (IBEA) population
+    npc_fit: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    new_pc: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # PC-exploration offspring awaiting the even phase
+    new_pc_fit: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     n_nd: jax.Array = field(sharding=P())
     counter: jax.Array = field(sharding=P())
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

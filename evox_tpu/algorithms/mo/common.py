@@ -28,9 +28,9 @@ from ...operators.mutation.ops import polynomial
 class MOState(PyTreeNode):
     # per-field mesh layout (core.distributed.state_sharding): population
     # arrays shard over "pop"; the rng key replicates
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))  # (pop, m)
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop, m)
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -33,13 +33,13 @@ from .moead import MOEAD
 
 
 class EAGMOEADState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))  # external archive (the algorithm's output)
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    inner_pop: jax.Array = field(sharding=P(POP_AXIS))  # MOEA/D working population
-    inner_fit: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # external archive (the algorithm's output)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    inner_pop: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # MOEA/D working population
+    inner_fit: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     success: jax.Array = field(sharding=P())  # (LP, n) archive admissions per subproblem
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
-    offspring_loc: jax.Array = field(sharding=P(POP_AXIS))  # (n,) subproblem each offspring came from
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    offspring_loc: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (n,) subproblem each offspring came from
     gen: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

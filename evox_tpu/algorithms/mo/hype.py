@@ -94,7 +94,7 @@ def exact_contrib_2d(fit: jax.Array, ref: jax.Array, rank: jax.Array) -> jax.Arr
 
 class HypEState(MOState):
     ref_point: jax.Array = field(sharding=P())  # (m,) fixed sampling reference
-    rank: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) survivors' non-domination ranks (exact — every
+    rank: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop,) survivors' non-domination ranks (exact — every
     # dominator of a survivor is itself kept, so ranks are subset-invariant)
 
 

@@ -30,9 +30,9 @@ from .common import uniform_init
 
 
 class IMMOEAState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -34,8 +34,8 @@ from .common import GAMOAlgorithm, MOState, uniform_init
 
 
 class KnEAState(MOState):
-    knee: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) bool
-    rank: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) survivors' non-domination ranks (exact: every
+    knee: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop,) bool
+    rank: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop,) survivors' non-domination ranks (exact: every
     # dominator of a survivor is itself kept, so ranks are subset-invariant)
     r: jax.Array = field(sharding=P())  # () adaptive radius factor
     t: jax.Array = field(sharding=P())  # () knee ratio of the last processed front

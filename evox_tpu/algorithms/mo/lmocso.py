@@ -23,9 +23,9 @@ from .sra import _sde_density
 
 
 class LMOCSOState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     offspring: jax.Array = field(sharding=P())
     off_velocity: jax.Array = field(sharding=P())
     gen: jax.Array = field(sharding=P())

@@ -31,10 +31,10 @@ from .common import uniform_init
 
 
 class MOEADState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     ideal: jax.Array = field(sharding=P())
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

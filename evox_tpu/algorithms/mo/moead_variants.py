@@ -33,12 +33,12 @@ from ...core.algorithm import Algorithm
 
 
 class MOEADDRAState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     ideal: jax.Array = field(sharding=P())
-    utility: jax.Array = field(sharding=P(POP_AXIS))
-    old_value: jax.Array = field(sharding=P(POP_AXIS))  # aggregation value per subproblem at last update
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    utility: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    old_value: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # aggregation value per subproblem at last update
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     gen: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
@@ -120,9 +120,9 @@ class MOEADDRA(MOEAD):
 
 
 class MOEADM2MState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

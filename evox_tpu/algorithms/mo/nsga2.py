@@ -29,11 +29,24 @@ from .common import GAMOAlgorithm, MOState
 
 
 class NSGA2State(MOState):
-    rank: jax.Array = field(sharding=P(POP_AXIS))  # survivors' Pareto rank from the last selection
-    crowd: jax.Array = field(sharding=P(POP_AXIS))  # survivors' crowding distance from the last selection
+    rank: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # survivors' Pareto rank from the last selection
+    crowd: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # survivors' crowding distance from the last selection
 
 
 class NSGA2(GAMOAlgorithm):
+    def __init__(self, *args, use_kernel=None, topk_interpret=False, **kwargs):
+        """``use_kernel``: route the environmental truncation's last-front
+        selection through the blockwise Pallas partial-top-k kernel
+        (kernels/topk.py) instead of the full ``lexsort`` — survivor set
+        identical, survivor order index-major (selection-law-equivalent:
+        mating re-keys from the carried (rank, crowd)). ``None`` =
+        backend default, currently off everywhere; the f32 lexsort path
+        stays bit-identical to pre-kernel behavior. ``topk_interpret``
+        runs the kernel in interpreter mode (CPU testing only)."""
+        super().__init__(*args, **kwargs)
+        self.use_kernel = use_kernel
+        self.topk_interpret = topk_interpret
+
     def init(self, key: jax.Array) -> NSGA2State:
         base = super().init(key)
         return NSGA2State(
@@ -59,7 +72,13 @@ class NSGA2(GAMOAlgorithm):
     def tell(self, state: NSGA2State, fitness: jax.Array) -> NSGA2State:
         merged_pop = jnp.concatenate([state.population, state.offspring], axis=0)
         merged_fit = jnp.concatenate([state.fitness, fitness], axis=0)
-        order, ranks = rank_crowding_truncate(merged_fit, self.pop_size, mesh=self.mesh)
+        order, ranks = rank_crowding_truncate(
+            merged_fit,
+            self.pop_size,
+            mesh=self.mesh,
+            use_kernel=self.use_kernel,
+            interpret=self.topk_interpret,
+        )
         fit_sel = merged_fit[order]
         return state.replace(
             population=merged_pop[order],

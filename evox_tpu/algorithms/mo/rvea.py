@@ -22,10 +22,10 @@ from .common import GAMOAlgorithm, MOState, uniform_init
 
 
 class RVEAState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    vectors: jax.Array = field(sharding=P(POP_AXIS))
-    offspring: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    vectors: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    offspring: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     gen: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

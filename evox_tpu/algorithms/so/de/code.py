@@ -27,9 +27,9 @@ _PARAM_POOL = jnp.asarray([[1.0, 0.1], [1.0, 0.9], [0.8, 0.2]], dtype=jnp.float3
 
 
 class CoDEState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    trials: jax.Array = field(sharding=P(POP_AXIS))  # (3*pop, dim)
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (3*pop, dim)
     key: jax.Array = field(sharding=P())
 
 

@@ -23,14 +23,14 @@ from .de import select_rand_indices
 
 
 class JaDEState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    trials: jax.Array = field(sharding=P(POP_AXIS))
-    F: jax.Array = field(sharding=P(POP_AXIS))  # per-individual, current generation
-    CR: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    F: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # per-individual, current generation
+    CR: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     mu_F: jax.Array = field(sharding=P())
     mu_CR: jax.Array = field(sharding=P())
-    archive: jax.Array = field(sharding=P(POP_AXIS))  # (pop, dim) replaced parents
+    archive: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop, dim) replaced parents
     archive_size: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

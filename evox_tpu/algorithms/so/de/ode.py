@@ -20,9 +20,9 @@ from .de import DE, DEState
 
 
 class ODEState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    trials: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

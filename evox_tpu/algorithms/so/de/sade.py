@@ -26,11 +26,11 @@ _N_STRATEGY = 4
 
 
 class SaDEState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    trials: jax.Array = field(sharding=P(POP_AXIS))
-    strategy: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) strategy chosen this generation
-    CR: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) crossover rate sampled this generation
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    strategy: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop,) strategy chosen this generation
+    CR: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop,) crossover rate sampled this generation
     probs: jax.Array = field(sharding=P())  # (4,) strategy selection probabilities
     success_mem: jax.Array = field(sharding=P())  # (LP, 4) success counts ring buffer
     failure_mem: jax.Array = field(sharding=P())

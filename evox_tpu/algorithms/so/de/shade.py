@@ -21,15 +21,15 @@ from .de import select_rand_indices
 
 
 class SHADEState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    trials: jax.Array = field(sharding=P(POP_AXIS))
-    F: jax.Array = field(sharding=P(POP_AXIS))
-    CR: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    F: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    CR: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     M_F: jax.Array = field(sharding=P())  # (H,)
     M_CR: jax.Array = field(sharding=P())
     mem_pos: jax.Array = field(sharding=P())
-    archive: jax.Array = field(sharding=P(POP_AXIS))
+    archive: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     archive_size: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

@@ -31,7 +31,7 @@ class AMaLGaMState(PyTreeNode):
     c_mult: jax.Array = field(sharding=P())
     best_fitness: jax.Array = field(sharding=P())
     no_improvement: jax.Array = field(sharding=P())
-    population: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -48,7 +48,7 @@ class CMAESState(PyTreeNode):
     C: jax.Array = field(sharding=P())
     B: jax.Array = field(sharding=P())
     D: jax.Array = field(sharding=P())
-    z: jax.Array = field(sharding=P(POP_AXIS))  # standardized samples of the current generation
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # standardized samples of the current generation
     iteration: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
@@ -181,7 +181,7 @@ class SepCMAESState(PyTreeNode):
     pc: jax.Array = field(sharding=P())
     ps: jax.Array = field(sharding=P())
     C: jax.Array = field(sharding=P())  # diagonal of the covariance
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     iteration: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

@@ -32,7 +32,7 @@ class CRFMNESState(PyTreeNode):
     D: jax.Array = field(sharding=P())
     v: jax.Array = field(sharding=P())
     ps: jax.Array = field(sharding=P())
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -23,7 +23,7 @@ from ....core.struct import PyTreeNode, field
 class DESState(PyTreeNode):
     mean: jax.Array = field(sharding=P())
     sigma: jax.Array = field(sharding=P())
-    population: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

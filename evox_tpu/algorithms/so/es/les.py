@@ -66,7 +66,7 @@ class LESState(PyTreeNode):
     sigma: jax.Array = field(sharding=P())
     path_mean: jax.Array = field(sharding=P())  # momentum-style evolution paths (3 timescales)
     path_sigma: jax.Array = field(sharding=P())
-    population: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

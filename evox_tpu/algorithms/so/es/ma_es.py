@@ -30,7 +30,7 @@ class MAESState(PyTreeNode):
     sigma: jax.Array = field(sharding=P())
     ps: jax.Array = field(sharding=P())
     M: jax.Array = field(sharding=P())
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 
@@ -108,7 +108,7 @@ class LMMAESState(PyTreeNode):
     sigma: jax.Array = field(sharding=P())
     ps: jax.Array = field(sharding=P())
     M: jax.Array = field(sharding=P())  # (m, dim) direction vectors
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     iteration: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

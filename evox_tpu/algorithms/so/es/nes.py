@@ -31,7 +31,7 @@ class XNESState(PyTreeNode):
     mean: jax.Array = field(sharding=P())
     sigma: jax.Array = field(sharding=P())
     B: jax.Array = field(sharding=P())  # normalized shape matrix; full transform A = sigma * B
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 
@@ -94,7 +94,7 @@ def _expm_sym(M: jax.Array) -> jax.Array:
 class SeparableNESState(PyTreeNode):
     mean: jax.Array = field(sharding=P())
     sigma: jax.Array = field(sharding=P())  # per-dimension stdev
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -32,7 +32,7 @@ class RMESState(PyTreeNode):
     prev_fitness: jax.Array = field(sharding=_PS())
     s: jax.Array = field(sharding=_PS())  # smoothed success measure
     iteration: jax.Array = field(sharding=_PS())
-    z: jax.Array = field(sharding=_PS(POP_AXIS))
+    z: jax.Array = field(sharding=_PS(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=_PS())
 
 

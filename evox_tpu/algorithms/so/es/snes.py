@@ -23,7 +23,7 @@ from .nes import nes_utilities
 class SNESState(PyTreeNode):
     mean: jax.Array = field(sharding=P())
     sigma: jax.Array = field(sharding=P())
-    z: jax.Array = field(sharding=P(POP_AXIS))
+    z: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -21,10 +21,10 @@ from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class CLPSOState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    pbest: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -56,9 +56,9 @@ class CSOState(PyTreeNode):
     # per-field mesh layout (consumed by core.distributed.state_sharding /
     # the workflow's constrain_state): population-leading arrays shard over
     # the "pop" axis, everything else replicates
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
     # the generation key ``ask`` drew — ``tell`` replays the pairing pass
     # from it instead of carrying five half-pop intermediate arrays in the

@@ -24,11 +24,11 @@ from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class DMSPSOELState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    pbest: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
-    swarm_of: jax.Array = field(sharding=P(POP_AXIS))  # (pop,) sub-swarm id per particle
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    swarm_of: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop,) sub-swarm id per particle
     gen: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())
 

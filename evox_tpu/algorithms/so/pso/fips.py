@@ -22,10 +22,10 @@ from .topology import full_neighbours, ring_neighbours, square_neighbours
 
 
 class FIPSState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    pbest: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

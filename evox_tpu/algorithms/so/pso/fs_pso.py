@@ -23,10 +23,10 @@ from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class FSPSOState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    pbest: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     gbest: jax.Array = field(sharding=P())
     gbest_fitness: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())

@@ -22,10 +22,10 @@ from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 class PSOState(PyTreeNode):
     # per-field mesh layout annotations (see core.distributed.state_sharding)
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_position: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_position: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     gbest_position: jax.Array = field(sharding=P())
     gbest_fitness: jax.Array = field(sharding=P())
     key: jax.Array = field(sharding=P())

@@ -22,9 +22,9 @@ from ....operators.sanitize import sanitize_bounds, validate_bound_handling
 
 
 class SLPSOState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     key: jax.Array = field(sharding=P())
 
 

@@ -28,10 +28,10 @@ from .topology import mutate_shortcuts, neighbour_best, ring_neighbours
 
 
 class SwmmPSOState(PyTreeNode):
-    population: jax.Array = field(sharding=P(POP_AXIS))
-    velocity: jax.Array = field(sharding=P(POP_AXIS))
-    pbest: jax.Array = field(sharding=P(POP_AXIS))
-    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS))
+    population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    velocity: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    pbest_fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     adjacency: jax.Array = field(sharding=P())  # bool (pop, pop); all-False when using static circles
     key: jax.Array = field(sharding=P())
 

@@ -25,6 +25,13 @@ from .instrument import (
     write_report_jsonl,
 )
 from .xla_cost import CHIP_CEILINGS, CostAnalyzer
+from .dtype_policy import (
+    BF16_STORAGE,
+    DtypePolicy,
+    apply_compute,
+    apply_storage,
+    policy_report,
+)
 from .guardrail import (
     GuardedAlgorithm,
     GuardedState,

@@ -129,16 +129,35 @@ def state_sharding(state: Any, mesh: Mesh, default: Optional["P"] = None) -> Any
     )
 
 
-def constrain_state(state: Any, mesh: Optional[Mesh]) -> Any:
+def constrain_state(
+    state: Any, mesh: Optional[Mesh], policy: Any = None
+) -> Any:
     """Tracing-time: constrain ANNOTATED leaves to their declared sharding.
 
     Unannotated leaves are left to GSPMD's propagation (constraining them
     to replicated would pessimize algorithms whose working arrays are
-    naturally population-sharded)."""
-    if mesh is None:
+    naturally population-sharded).
+
+    ``policy``: an optional :class:`~evox_tpu.core.dtype_policy.
+    DtypePolicy`. When active, ``field(storage=True)``-annotated float
+    leaves are additionally cast to the policy's *storage* dtype in the
+    same tree walk — this is the workflow's end-of-step boundary, so the
+    loop-carried state leaves HBM at half width while every in-step
+    reduction already ran in the compute dtype (see core/dtype_policy.py).
+    ``policy=None`` (or a no-op policy) changes nothing, and a policy
+    applies even without a mesh (single-device bf16 storage is the same
+    bytes win)."""
+    from .dtype_policy import _castable, _storage_flag_for_path
+
+    active = policy is not None and not policy.is_noop
+    if mesh is None and not active:
         return state
 
     def constrain(path, x):
+        if active and _castable(x) and _storage_flag_for_path(state, path):
+            x = jax.lax.convert_element_type(x, policy.storage)
+        if mesh is None:
+            return x
         spec = _spec_for_path(state, path, None)
         if spec is None:
             return x

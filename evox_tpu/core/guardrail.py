@@ -65,7 +65,7 @@ TRIGGER_STAGNATION = 8  # generations without best-so-far improvement
 
 class GuardedState(PyTreeNode):
     inner: Any  # wrapped algorithm state (sharding: the inner annotations)
-    pop: Any = field(sharding=P(POP_AXIS))  # last asked candidate batch
+    pop: Any = field(sharding=P(POP_AXIS), storage=True)  # last asked candidate batch
     best_x: Any = field(sharding=P())  # best-so-far candidate
     best_fitness: jax.Array = field(sharding=P())  # internal (minimize) key
     stagnation: jax.Array = field(sharding=P())  # gens since best improved

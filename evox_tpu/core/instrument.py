@@ -502,7 +502,10 @@ def run_report(
     a zero-arg ``report()`` works, and core stays decoupled from the
     workflows package.
     """
-    report: dict = {"schema": "evox_tpu.run_report/v1"}
+    # v2: roofline sections carry dtype_policy + donation provenance
+    # (tools/check_report.py enforces them for v2+, exempting the
+    # historical v1 captures)
+    report: dict = {"schema": "evox_tpu.run_report/v2"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -540,6 +543,31 @@ def run_report(
             report["roofline"] = roofline_section(
                 analyzer.analyses, summary, analyzer.ceilings
             )
+        if (
+            isinstance(report.get("roofline"), dict)
+            and "entries" in report["roofline"]
+        ):
+            # precision/donation provenance (PR 6): rates are only
+            # interpretable next to the dtype the state was stored at and
+            # whether the run carry was donated (alias_bytes per entry
+            # live in entries[*].static.memory.alias_bytes). Attached for
+            # EVERY v2 roofline — a workflow-less (bare-analyzer) report
+            # falls back to the explicit f32/undonated defaults via
+            # policy_report(None)/getattr, keeping the v2 schema coherent
+            # with tools/check_report.py's required fields
+            from .dtype_policy import policy_report
+
+            report["roofline"]["dtype_policy"] = policy_report(workflow)
+            report["roofline"]["donation"] = {
+                "donate_carries": bool(
+                    getattr(workflow, "donate_carries", False)
+                ),
+                "alias_bytes": {
+                    name: (a.get("memory") or {}).get("alias_bytes", 0)
+                    for name, a in analyzer.analyses.items()
+                    if isinstance(a, dict) and "error" not in a
+                },
+            }
     if supervisor is None and workflow is not None:
         supervisor = getattr(workflow, "_run_supervisor", None)
     if supervisor is not None and hasattr(supervisor, "report"):

@@ -29,7 +29,11 @@ __all__ = [
 
 
 def field(
-    *, static: bool = False, sharding: Any = None, **kwargs: Any
+    *,
+    static: bool = False,
+    sharding: Any = None,
+    storage: Any = None,
+    **kwargs: Any,
 ) -> dataclasses.Field:
     """A dataclass field; ``static=True`` marks it as pytree metadata
     (hashable aux data, not traced).
@@ -42,11 +46,27 @@ def field(
     workflow each step — this makes the annotation the single source of
     truth for state layout (the reference declared the same idea but never
     consumed it; reference core/pytree_dataclass.py:12-19, SURVEY §2.3).
+
+    ``storage``: the mixed-precision storage annotation, consumed by
+    :mod:`evox_tpu.core.dtype_policy`. ``storage=True`` marks the field's
+    floating-point leaves as *storage-eligible*: under a workflow
+    ``DtypePolicy(storage=bf16, compute=f32)`` they are held in the
+    storage dtype between generations (halving the loop-carry HBM bytes
+    of every memory-bound leg) and upcast to the compute dtype at the
+    step boundary, so all algorithm math — reductions, means, covariance
+    updates — runs in the compute dtype. ``storage=False`` explicitly
+    opts a field out (must-stay-f32); ``None`` (default) is treated as
+    ineligible. Integer/bool/key leaves are never cast regardless of the
+    annotation. Convention (enforced by tests/test_state_contracts.py):
+    population-leading float fields carry an explicit ``storage``
+    annotation alongside their ``sharding=P(POP_AXIS)``.
     """
     metadata = dict(kwargs.pop("metadata", {}) or {})
     metadata["static"] = static
     if sharding is not None:
         metadata["sharding"] = sharding
+    if storage is not None:
+        metadata["storage"] = bool(storage)
     return dataclasses.field(metadata=metadata, **kwargs)
 
 

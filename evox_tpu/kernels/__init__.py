@@ -10,6 +10,7 @@ fallback stays the default.
 """
 
 from .dominance import packed_dominance, packed_dominance_reference
+from .topk import default_use_kernel, partial_topk, partial_topk_reference
 from .rollout import (
     SoAEnv,
     acrobot_soa,
@@ -28,6 +29,9 @@ from .rollout_mlp import (
 __all__ = [
     "packed_dominance",
     "packed_dominance_reference",
+    "default_use_kernel",
+    "partial_topk",
+    "partial_topk_reference",
     "SoAEnv",
     "acrobot_soa",
     "cartpole_soa",

@@ -10,6 +10,8 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ...kernels.topk import partial_topk
+
 
 def tournament(
     key: jax.Array,
@@ -70,10 +72,23 @@ def roulette_wheel(
     return pop[idx]
 
 
-def topk_fit(pop: jax.Array, fitness: jax.Array, topk: int):
-    """Keep the ``topk`` fittest (reference topk_fit.py:41)."""
-    fit, idx = jax.lax.top_k(-fitness, topk)
-    return pop[idx], -fit
+def topk_fit(
+    pop: jax.Array,
+    fitness: jax.Array,
+    topk: int,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """Keep the ``topk`` fittest (reference topk_fit.py:41).
+
+    ``use_kernel``: route through the blockwise Pallas partial-selection
+    kernel (kernels/topk.py) instead of a full ``lax.top_k`` over ``n``
+    — identical output (values, order, tie law). ``None`` = backend
+    default (currently off everywhere; see kernels/topk.py)."""
+    fit, idx = partial_topk(
+        fitness, topk, use_kernel=use_kernel, interpret=interpret
+    )
+    return pop[idx], fit
 
 
 def uniform_rand(key: jax.Array, pop: jax.Array, n: int) -> jax.Array:
@@ -87,12 +102,19 @@ def select_rand_pbest(
     percent: float,
     pop: jax.Array,
     fitness: jax.Array,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """For each individual, pick a random member of the best ``percent``
     fraction of the population (DE current-to-pbest; reference find_pbest.py).
-    """
+
+    The best-``p%`` set is a textbook partial selection (``top <<
+    n``) — ``use_kernel`` routes it through kernels/topk.py, identical
+    result (``None`` = backend default, currently off)."""
     n = pop.shape[0]
     top = max(1, int(n * percent))
-    _, best_idx = jax.lax.top_k(-fitness, top)
+    _, best_idx = partial_topk(
+        fitness, top, use_kernel=use_kernel, interpret=interpret
+    )
     choice = jax.random.randint(key, (n,), 0, top)
     return pop[best_idx[choice]]

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...core.distributed import POP_AXIS
 from ...kernels.dominance import pack_dominator_rows, packed_dominance
+from ...kernels.topk import default_use_kernel, partial_topk
 from ...utils.common import dominate_relation
 from ...utils.compat import shard_map
 
@@ -339,6 +340,8 @@ def rank_crowding_truncate(
     fitness: jax.Array,
     k: int,
     mesh: Optional[jax.sharding.Mesh] = None,
+    use_kernel: Optional[bool] = None,
+    interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """NSGA-II environmental truncation: the ``k`` survivors of ``fitness``
     ``(n, m)`` by (Pareto rank asc, crowding distance desc on the cut
@@ -349,10 +352,46 @@ def rank_crowding_truncate(
 
     The worst admitted rank comes from the peel loop's free cut-rank
     by-product (PERF_NOTES §4) — a ``jnp.sort(rank)[k-1]`` here would
-    re-pay the ~5 ms O(n log n) pass that optimization removed."""
+    re-pay the ~5 ms O(n log n) pass that optimization removed.
+
+    ``use_kernel`` (``None`` = backend default, currently off —
+    kernels/topk.py): replace the O(n log n) full ``lexsort`` with the
+    last-front decomposition the peel loop already paid for — ranks
+    better than the cut are admitted wholesale by an O(n) stable
+    cumsum-scatter compaction (no sort), and only the CUT front is
+    actually selected on, by crowding distance through the blockwise
+    partial-top-k kernel. The survivor SET is identical to the lexsort
+    path (same rank admission, same crowding ties broken by lowest
+    index); the survivor ORDER differs — auto-admitted fronts come back
+    in index order rather than rank-major order — which is selection-
+    law-equivalent for every caller (NSGA-II re-keys its mating
+    tournament from the returned ranks/crowding, and the population is
+    a set). Asserted in tests/test_topk.py."""
     rank, worst_rank = non_dominated_sort(
         fitness, until=k, return_cut_rank=True, mesh=mesh
     )
     crowd = crowding_distance(fitness, mask=rank == worst_rank)
-    order = jnp.lexsort((-crowd, rank))[:k]
+    if use_kernel is None:
+        use_kernel = default_use_kernel()
+    if not use_kernel:
+        order = jnp.lexsort((-crowd, rank))[:k]
+        return order, rank[order]
+    n = fitness.shape[0]
+    better = rank < worst_rank  # whole fronts above the cut: all admitted
+    n_better = jnp.sum(better, dtype=jnp.int32)  # < k by cut construction
+    # stable O(n) compaction of the auto-admitted rows (index order)
+    pos = jnp.cumsum(better.astype(jnp.int32)) - 1
+    order = jnp.zeros((k,), dtype=jnp.int32).at[
+        jnp.where(better, pos, k)
+    ].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    # cut front: fill the remaining k - n_better slots by crowding desc.
+    # Non-front rows carry +inf keys; boundary members carry -inf (from
+    # crowd=+inf) — the kernel's masked-min handles both exactly
+    cut_key = jnp.where(rank == worst_rank, -crowd, jnp.inf)
+    _, cut_idx = partial_topk(
+        cut_key, k, use_kernel=True, interpret=interpret
+    )
+    j = jnp.arange(k, dtype=jnp.int32)
+    slots = jnp.where(j < (k - n_better), n_better + j, k)
+    order = order.at[slots].set(cut_idx, mode="drop")
     return order, rank[order]

@@ -45,23 +45,45 @@ def finish_step(
     return new_state.replace(monitors=tuple(mstates))
 
 
-def make_run_loop(step_impl: Callable) -> Callable:
+def make_run_loop(step_impl: Callable, donate: bool = False) -> Callable:
     """Jitted ``(state, n) -> state`` running ``step_impl`` n times in one
     on-device ``fori_loop``; the trip count is a traced operand, so one
-    compilation covers every ``n``."""
+    compilation covers every ``n``.
+
+    ``donate=True`` donates the state carry (``donate_argnums=0``): XLA
+    aliases the input state's buffers into the loop carry and output
+    instead of double-buffering them across the program boundary — the
+    aliasing shows up as ``alias_bytes`` in ``memory_analysis()`` and as
+    reduced peak bytes in ``run_report()["roofline"]``. The donated input
+    is INVALIDATED after the call. Default False (matching the
+    workflows' ``donate_carries`` default): whoever turns it on owns the
+    snapshot-before-donate contract — the loop must only ever be fed
+    states its driver produced itself. :func:`fused_run` (the driver
+    behind ``StdWorkflow.run``/``IslandWorkflow.run``) honors it by
+    advancing caller-owned states one non-donating ``wf.step`` first, so
+    checkpoints are always taken from states the loop never donates."""
     return jax.jit(
-        lambda s, n: jax.lax.fori_loop(0, n, lambda _, x: step_impl(x), s)
+        lambda s, n: jax.lax.fori_loop(0, n, lambda _, x: step_impl(x), s),
+        donate_argnums=(0,) if donate else (),
     )
 
 
 def fused_run(wf: Any, state: Any, n_steps: int) -> Any:
-    """Shared ``run()`` body: peel the first (init_ask-dispatching)
-    generation eagerly so the loop carry stays type-stable, then hand the
-    rest to ``wf._run_loop`` (or an eager Python loop when
+    """Shared ``run()`` body: peel the first generation eagerly through
+    the non-donating ``wf.step`` — both for the init_ask dispatch (the
+    loop carry stays type-stable) and so the CALLER's state buffers are
+    never donated (the step's output is a fresh intermediate owned by
+    this function; jax 0.4.x does not forward unchanged inputs to
+    outputs, verified in tests/test_dtype_policy.py) — then hand the rest
+    to the donated ``wf._run_loop`` (or an eager Python loop when
     ``wf.jit_step=False``)."""
     if n_steps <= 0:
         return state
-    if state.first_step:
+    # the peel is mandatory when the loop donates: without it a warm
+    # caller state would be handed straight to the donated loop and the
+    # caller's arrays (bench re-timing loops, checkpointer snapshots,
+    # test fixtures) would be invalidated under it
+    if state.first_step or getattr(wf, "donate_carries", False):
         state = wf.step(state)
         n_steps -= 1
     if not wf.jit_step:

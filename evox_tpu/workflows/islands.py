@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from ..core.algorithm import Algorithm
 from ..core.distributed import POP_AXIS as _POP_AXIS_NAME, shard_pop
+from ..core.dtype_policy import DtypePolicy, apply_compute, apply_storage
 from ..core.monitor import Monitor
 from ..core.problem import Problem
 from ..core.struct import PyTreeNode, static_field
@@ -107,6 +108,10 @@ class IslandWorkflow:
         external_problem: Optional[bool] = None,
         num_objectives: int = 1,
         jit_step: bool = True,
+        dtype_policy: Optional[DtypePolicy] = None,
+        donate_carries: bool = False,
+        use_topk_kernel: Optional[bool] = None,
+        topk_interpret: bool = False,
     ):
         if n_islands < 2:
             raise ValueError(f"need at least 2 islands, got {n_islands}")
@@ -144,8 +149,15 @@ class IslandWorkflow:
                     f"'pop' axis ({n_shards} shards)"
                 )
         self.jit_step = jit_step
+        self.dtype_policy = dtype_policy
+        self.donate_carries = bool(donate_carries) and jit_step
+        # per-island elite selection through the Pallas partial-top-k
+        # kernel (kernels/topk.py); None = backend default (currently
+        # off), topk_interpret is the CPU-testing escape hatch
+        self.use_topk_kernel = use_topk_kernel
+        self.topk_interpret = topk_interpret
         self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
-        self._run_loop = make_run_loop(self._step_impl)
+        self._run_loop = make_run_loop(self._step_impl, donate=self.donate_carries)
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> IslandWorkflowState:
@@ -153,13 +165,16 @@ class IslandWorkflow:
         island_keys = jax.random.split(keys[1], self.n_islands)
         algo = jax.vmap(self.algorithm.init)(island_keys)
         algo = self._constrain(algo)
-        return IslandWorkflowState(
+        state = IslandWorkflowState(
             generation=jnp.zeros((), dtype=jnp.int32),
             algo=algo,
             prob=self.problem.init(keys[0]),
             monitors=tuple(m.init(k) for m, k in zip(self.monitors, keys[2:])),
             first_step=True,
         )
+        # island-stacked leaves rest at storage width from the start (the
+        # field annotations resolve through the extra island axis)
+        return apply_storage(state, self.dtype_policy)
 
     # ------------------------------------------------------------------ step
     def step(self, state: IslandWorkflowState) -> IslandWorkflowState:
@@ -286,7 +301,24 @@ class IslandWorkflow:
             recv = jax.tree.map(lambda e: jnp.roll(e, 1, axis=0), elites)
             recv_fit = jnp.roll(elite_fit, 1, axis=0)
             return jax.vmap(self.algorithm.migrate)(astate, recv, recv_fit)
-        idx = jnp.argsort(fitness, axis=1)[:, :k]  # best-k per island
+        from ..kernels.topk import default_use_kernel, partial_topk
+
+        use_kernel = (
+            default_use_kernel()
+            if self.use_topk_kernel is None
+            else self.use_topk_kernel
+        )
+        if use_kernel:
+            # best-k per island through the blockwise partial-selection
+            # kernel — same indices as the stable argsort (ascending,
+            # ties by lowest index), vmapped over the island axis
+            idx = jax.vmap(
+                lambda f: partial_topk(
+                    f, k, use_kernel=True, interpret=self.topk_interpret
+                )[1]
+            )(fitness)
+        else:
+            idx = jnp.argsort(fitness, axis=1)[:, :k]  # best-k per island
         elites = jax.tree.map(
             lambda c: jax.vmap(lambda row, i: row[i])(c, idx), cand
         )
@@ -298,6 +330,8 @@ class IslandWorkflow:
         return jax.vmap(self.algorithm.migrate)(astate, recv, recv_fit)
 
     def _step_impl(self, state: IslandWorkflowState) -> IslandWorkflowState:
+        # storage -> compute at step entry (see StdWorkflow._step_impl)
+        state = apply_compute(state, self.dtype_policy)
         mstates = list(state.monitors)
         run_hooks(self.monitors, self._hook_table, "pre_step", mstates)
         run_hooks(self.monitors, self._hook_table, "pre_ask", mstates)
@@ -351,7 +385,9 @@ class IslandWorkflow:
             lambda a: a,
             astate,
         )
-        astate = self._constrain(astate)
+        # downcast to storage width BEFORE the shard constraint so the
+        # loop carry streams at half width on every device
+        astate = self._constrain(apply_storage(astate, self.dtype_policy))
         new_state = state.replace(
             generation=gen,
             algo=astate,

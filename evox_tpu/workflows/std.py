@@ -39,6 +39,7 @@ from ..core.distributed import (
     constrain_state,
     shard_pop,
 )
+from ..core.dtype_policy import DtypePolicy, apply_compute, apply_storage
 from ..utils.common import parse_opt_direction
 from .checkpoint import (
     WorkflowCheckpointer,
@@ -115,6 +116,34 @@ class StdWorkflow:
             Monitors' ``post_eval`` (including TelemetryMonitor's NaN/Inf
             counters) still observe the RAW fitness, so quarantined
             candidates remain visible in telemetry.
+        dtype_policy: an optional :class:`~evox_tpu.core.dtype_policy.
+            DtypePolicy` (e.g. ``BF16_STORAGE``). ``field(storage=True)``-
+            annotated float leaves of the state are held in the policy's
+            storage dtype between generations (halving the memory-bound
+            legs' loop-carry HBM traffic) and upcast to the compute dtype
+            at step entry, so every reduction/mean/covariance update runs
+            full-precision. ``None`` (default) is bit-identical to the
+            pre-policy behavior. Checkpoints snapshot the storage-dtype
+            leaves; resume with the same policy (the config-fingerprint
+            guard records leaf dtypes and refuses cross-policy restores).
+        donate_carries: donate the fused ``run`` loop's state carry and
+            the pipelined ``tell``'s ask-context (``jax.jit``
+            ``donate_argnums``), eliminating the per-dispatch state copy —
+            donation shows up as ``alias_bytes`` in the roofline report's
+            memory analysis. Caller-visible semantics are preserved:
+            ``run()`` advances caller-owned states one non-donating
+            ``step`` first and only donates its own intermediates, and
+            checkpoint snapshots are always taken from never-donated
+            states (snapshot-before-donate). Sharp edges, and why the
+            default is False: (a) ``pipeline_ask``'s returned ctx is
+            consumed-and-invalidated by ``pipeline_tell`` — don't reuse a
+            ctx across tells (``run_host_pipelined`` never does); (b)
+            donation changes XLA's fusion clustering inside the run loop,
+            which perturbs float results at the last ulp (measured: CSO
+            loser rows differ by 1 ulp on the CPU backend) — so the
+            default stays off to keep the fused run bit-identical to a
+            ``step`` loop (the repo's equivalence laws), and donation is
+            the explicit perf knob the bench legs turn on.
     """
 
     def __init__(
@@ -133,6 +162,8 @@ class StdWorkflow:
         allow_uneven_shards: bool = False,
         migrate_helper: Optional[Callable] = None,
         quarantine_nonfinite: bool = False,
+        dtype_policy: Optional[DtypePolicy] = None,
+        donate_carries: bool = False,
     ):
         self.algorithm = algorithm
         self.problem = problem
@@ -146,6 +177,8 @@ class StdWorkflow:
         self.eval_shard_map = eval_shard_map
         self.migrate_helper = migrate_helper
         self.quarantine_nonfinite = quarantine_nonfinite
+        self.dtype_policy = dtype_policy
+        self.donate_carries = bool(donate_carries) and jit_step
         # migration stores raw (sign-flipped) fitness into the algorithm
         # state; population-relative shaped fitness cannot coexist with it
         # (the stored conventions would mix) — see Algorithm.migrate
@@ -205,17 +238,28 @@ class StdWorkflow:
             allow_uneven_shards=allow_uneven_shards,
             migrate_helper=self.migrate_helper,
             quarantine_nonfinite=self.quarantine_nonfinite,
+            dtype_policy=self.dtype_policy,
+            donate_carries=donate_carries,
         )
         for m in self.monitors:
             m.set_opt_direction(self.opt_direction)
         self._hook_table = build_hook_table(self.monitors)
         self.jit_step = jit_step
         self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
-        # dynamic trip count: ONE compile covers every n_steps
-        self._run_loop = make_run_loop(self._step_impl)
-        # jitted step halves for the host-overlap driver (pipelined.py)
+        # dynamic trip count: ONE compile covers every n_steps; the carry
+        # is donated (fused_run only feeds it internally-produced states)
+        self._run_loop = make_run_loop(self._step_impl, donate=self.donate_carries)
+        # jitted step halves for the host-overlap driver (pipelined.py);
+        # tell consumes-and-invalidates ask's ctx (argnum 1) when donating
         self._p_ask = jax.jit(self._pipeline_ask_impl) if jit_step else self._pipeline_ask_impl
-        self._p_tell = jax.jit(self._pipeline_tell_impl) if jit_step else self._pipeline_tell_impl
+        self._p_tell = (
+            jax.jit(
+                self._pipeline_tell_impl,
+                donate_argnums=(1,) if self.donate_carries else (),
+            )
+            if jit_step
+            else self._pipeline_tell_impl
+        )
 
     def clone_with_algorithm(self, algorithm: Algorithm) -> "StdWorkflow":
         """A new workflow identical to this one but driving ``algorithm``
@@ -268,13 +312,16 @@ class StdWorkflow:
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> StdWorkflowState:
         keys = jax.random.split(key, 2 + len(self.monitors))
-        return StdWorkflowState(
+        state = StdWorkflowState(
             generation=jnp.zeros((), dtype=jnp.int32),
             algo=self.algorithm.init(keys[0]),
             prob=self.problem.init(keys[1]),
             monitors=tuple(m.init(k) for m, k in zip(self.monitors, keys[2:])),
             first_step=True,
         )
+        # storage-annotated leaves rest in the policy's storage dtype from
+        # the very first state, so the step signature never changes
+        return apply_storage(state, self.dtype_policy)
 
     # ------------------------------------------------------------------ step
     def step(self, state: StdWorkflowState) -> StdWorkflowState:
@@ -427,7 +474,8 @@ class StdWorkflow:
         return use_init, pop, astate
 
     def _ask_preview(self, state: StdWorkflowState) -> Any:
-        return self._dispatch_ask(state)[1]
+        # previews see the same compute-dtype view the step itself asks on
+        return self._dispatch_ask(apply_compute(state, self.dtype_policy))[1]
 
     def sample(self, state: StdWorkflowState) -> Any:
         """The population the algorithm would propose next, without
@@ -553,6 +601,9 @@ class StdWorkflow:
         return self._p_tell(state, ctx, fitness, pstate)
 
     def _pipeline_ask_impl(self, state: StdWorkflowState):
+        # storage -> compute at the step boundary: ask's math (and the
+        # ctx it hands to tell) runs full-precision
+        state = apply_compute(state, self.dtype_policy)
         mstates = list(state.monitors)
         self._run_hooks("pre_step", mstates)
         self._run_hooks("pre_ask", mstates)
@@ -594,7 +645,9 @@ class StdWorkflow:
                 lambda a: a,
                 astate,
             )
-        astate = constrain_state(astate, self.mesh)
+        # end-of-step boundary: declared sharding + storage-dtype downcast
+        # in one fused walk (core/distributed.constrain_state)
+        astate = constrain_state(astate, self.mesh, self.dtype_policy)
         self._run_hooks("post_tell", mstates)
         new_state = state.replace(
             generation=state.generation + 1,
@@ -606,6 +659,10 @@ class StdWorkflow:
         return finish_step(self.monitors, self._hook_table, new_state)
 
     def _step_impl(self, state: StdWorkflowState) -> StdWorkflowState:
+        # storage -> compute upcast at step entry: every reduction, mean
+        # and covariance update below runs in the compute dtype; only the
+        # state carried OUT of the step (constrain_state below) is narrow
+        state = apply_compute(state, self.dtype_policy)
         mstates = list(state.monitors)
         self._run_hooks("pre_step", mstates)
         self._run_hooks("pre_ask", mstates)
@@ -652,8 +709,10 @@ class StdWorkflow:
             )
 
         # apply per-field sharding annotations (field(sharding=...)) so the
-        # loop-carried algorithm state keeps its declared mesh layout
-        astate = constrain_state(astate, self.mesh)
+        # loop-carried algorithm state keeps its declared mesh layout; an
+        # active dtype policy downcasts storage-annotated leaves in the
+        # same walk — the carry leaves the step at storage width
+        astate = constrain_state(astate, self.mesh, self.dtype_policy)
         self._run_hooks("post_tell", mstates)
 
         new_state = state.replace(

@@ -198,6 +198,18 @@ def test_fault_tolerance_modules_are_callback_free():
         assert rel not in users, f"{rel} must not use host callbacks"
 
 
+def test_precision_and_topk_modules_are_callback_free():
+    """The PR-6 precision/memory layer must hold the axon constraint by
+    construction: the dtype policy is pure ``convert_element_type`` math
+    applied inside traced code, and the partial-top-k kernel is a Pallas
+    body + XLA merge — a host callback in either would make bf16 storage
+    or kernel selection unusable on the tunneled TPU."""
+    users = _scan()
+    for rel in ("core/dtype_policy.py", "kernels/topk.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"
+
+
 def test_supervisor_module_is_callback_free():
     """The PR-5 run supervisor is pure host-side control flow — watchdog
     threads, error classification, backoff sleeps, checkpoint replay —

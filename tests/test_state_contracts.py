@@ -20,8 +20,20 @@ actual leaf shapes:
 - every other (non-static) field must be annotated ``P()``;
 - the state class must be a frozen dataclass registered as a JAX pytree.
 
+PR 6 adds the dtype-policy half of the convention (core/dtype_policy.py):
+
+- every population-leading field with FLOAT leaves must carry an explicit
+  ``storage`` annotation (``True`` = held at storage width under a
+  ``DtypePolicy``; ``False`` = documented must-stay-f32 opt-out) — a
+  forgotten annotation silently exempts the field from the bf16 storage
+  mode and the memory-bound legs stop shrinking;
+- non-population fields must NOT be ``storage=True``: replicated strategy
+  state (CMA mean/covariance/paths, step sizes) is exactly the
+  must-stay-f32 set, kept full-precision by being unannotated.
+
 Monitor states get the same structural checks (their buffers are
-capacity-leading, never population-leading, so everything is ``P()``).
+capacity-leading, never population-leading, so everything is ``P()``
+and never storage-annotated).
 Classes the pool cannot construct are skipped EXPLICITLY — a baseline
 assertion pins the set of covered classes so coverage can only grow.
 """
@@ -173,6 +185,10 @@ def _check_state(state, where, pop=POP):
             # nested state: its own fields are checked by the recursion;
             # the outer field needs no (single) annotation
             continue
+        storage = f.metadata.get("storage")
+        has_float = any(
+            jnp.issubdtype(l.dtype, jnp.floating) for l in field_leaves
+        )
         if pop_leading:
             if spec != P(POP_AXIS):
                 errors.append(
@@ -180,11 +196,25 @@ def _check_state(state, where, pop=POP):
                     f"(shape {field_leaves[0].shape}) but annotated {spec!r}; "
                     f"expected field(sharding=P(POP_AXIS))"
                 )
+            if has_float and storage is None:
+                errors.append(
+                    f"{where}.{path}: population-leading float field has no "
+                    "dtype-policy annotation; add field(..., storage=True) "
+                    "(or an explicit storage=False must-stay-f32 opt-out, "
+                    "documented in the state class)"
+                )
         else:
             if spec != P():
                 errors.append(
                     f"{where}.{path}: annotated {spec!r}; expected "
                     "field(sharding=P()) for non-population fields"
+                )
+            if storage:
+                errors.append(
+                    f"{where}.{path}: non-population field annotated "
+                    "storage=True — replicated strategy state is the "
+                    "must-stay-f32 set (CMA mean/covariance/paths); leave "
+                    "it unannotated"
                 )
     assert not errors, "\n".join(errors)
 
@@ -268,4 +298,8 @@ def test_monitor_state_contracts():
             assert spec == P(), (
                 f"{type(mon).__name__}.{path}: annotated {spec!r}; monitor "
                 "state fields must be field(sharding=P())"
+            )
+            assert not f.metadata.get("storage"), (
+                f"{type(mon).__name__}.{path}: monitor state must not be "
+                "storage-annotated (telemetry/history buffers stay f32)"
             )

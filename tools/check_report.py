@@ -72,6 +72,7 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     if not isinstance(report, dict):
         return [f"{where}: not a JSON object"]
     schema = report.get("schema")
+    schema_version = 1
     if not isinstance(schema, str) or not schema.startswith(
         RUN_REPORT_SCHEMA_PREFIX
     ):
@@ -79,6 +80,11 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
             f"{where}: missing/unknown schema key (want "
             f"'{RUN_REPORT_SCHEMA_PREFIX}*', got {schema!r})"
         )
+    else:
+        try:
+            schema_version = int(schema.rsplit("/v", 1)[1])
+        except (IndexError, ValueError):
+            schema_version = 1
     errors += [f"{where}: non-finite number at {p}" for p in find_nonfinite(report)]
     for i, mon in enumerate(report.get("telemetry", []) or []):
         if not isinstance(mon, dict) or "monitor" not in mon:
@@ -194,6 +200,62 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                             f"{entry.get('classification')!r} not in "
                             f"{sorted(c for c in CLASSIFICATIONS if c)}"
                         )
+                # PR-6 provenance (schema v2+): rates are only
+                # interpretable next to the dtype the state was stored at
+                # and whether the run carry was donated — a v2 roofline
+                # section without them is stale. v1 captures predate the
+                # fields and stay valid as recorded.
+                dp = roofline.get("dtype_policy")
+                if schema_version < 2:
+                    pass
+                elif not isinstance(dp, dict):
+                    errors.append(f"{where}: roofline.dtype_policy missing")
+                else:
+                    for key in ("storage", "compute"):
+                        if not isinstance(dp.get(key), str):
+                            errors.append(
+                                f"{where}: roofline.dtype_policy.{key} "
+                                "missing or not a dtype name"
+                            )
+                    if not isinstance(dp.get("active"), bool):
+                        errors.append(
+                            f"{where}: roofline.dtype_policy.active missing"
+                        )
+                don = roofline.get("donation")
+                if schema_version < 2:
+                    pass
+                elif not isinstance(don, dict):
+                    errors.append(f"{where}: roofline.donation missing")
+                else:
+                    if not isinstance(don.get("donate_carries"), bool):
+                        errors.append(
+                            f"{where}: roofline.donation.donate_carries "
+                            "missing or not a bool"
+                        )
+                    ab = don.get("alias_bytes")
+                    if not isinstance(ab, dict) or not all(
+                        isinstance(v, int) and v >= 0 for v in ab.values()
+                    ):
+                        errors.append(
+                            f"{where}: roofline.donation.alias_bytes missing "
+                            "or not a {entry: non-negative int} map"
+                        )
+                    elif don.get("donate_carries") and not any(
+                        v > 0 for v in ab.values()
+                    ) and any(
+                        name in ab for name in ("run", "pipeline_tell")
+                    ):
+                        # coherence is only checkable when a DONATED entry
+                        # (run carry / pipelined tell-ctx) actually got a
+                        # successful memory analysis — degraded analyses
+                        # (per-entry 'error' statics, the designed AOT
+                        # fallback) drop out of the map and must not flag
+                        errors.append(
+                            f"{where}: roofline.donation claims "
+                            "donate_carries but the analyzed run/"
+                            "pipeline_tell entries show zero alias bytes — "
+                            "the aliasing never reached the compiled program"
+                        )
     return errors
 
 
@@ -223,6 +285,21 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             not isinstance(rounds, list) or not all(_num(r) for r in rounds)
         ):
             errors.append(f"{loc}.ratio_rounds neither null nor numeric list")
+        if "bf16" in str(leg.get("metric", "")).lower():
+            # a bf16 A/B leg without its f32 reference ratio is an
+            # asserted win, not a measured one — reject it
+            if vs is None or not _num(vs):
+                errors.append(
+                    f"{loc}: bf16 leg is missing its f32 reference ratio "
+                    "(vs_baseline null) — the storage-policy win must be "
+                    "measured, not asserted"
+                )
+            if rounds is None:
+                errors.append(
+                    f"{loc}: bf16 leg has no ratio_rounds — the A/B "
+                    "spread is the self-check the differenced protocol "
+                    "requires"
+                )
     rr = summary.get("run_report")
     if rr is not None:
         errors += validate_run_report(rr, where=f"{where}: run_report")
