@@ -47,7 +47,12 @@ class ASEBO(Algorithm):
         self.dim = int(self.center_init.shape[0])
         self.pop_size = pop_size
         self.n_pairs = pop_size // 2
-        self.k = subspace_dims
+        # the active subspace cannot exceed the ambient dimension: for
+        # dim < subspace_dims the reduced QR of the (dim, k) archive
+        # yields a (dim, dim) basis and the unclamped z_sub matmul is
+        # shape-inconsistent (caught by the vmap state contract,
+        # tests/test_state_contracts.py::test_algorithm_vmap_contract)
+        self.k = min(subspace_dims, self.dim)
         self.decay = decay
         self.noise_stdev = noise_stdev
         self.optimizer = make_optimizer(optimizer, learning_rate)

@@ -4,6 +4,8 @@ from .problem import Problem
 from .monitor import Monitor, HOOK_NAMES
 from .distributed import (
     POP_AXIS,
+    TENANT_AXIS,
+    match_partition_rules,
     create_mesh,
     pop_sharding,
     replicated_sharding,
@@ -63,6 +65,8 @@ __all__ = [
     "Monitor",
     "HOOK_NAMES",
     "POP_AXIS",
+    "TENANT_AXIS",
+    "match_partition_rules",
     "create_mesh",
     "pop_sharding",
     "replicated_sharding",

@@ -18,22 +18,30 @@ jittable problems.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+import re
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 POP_AXIS = "pop"
+# Second mesh axis for multi-tenant fleets (workflows/tenancy.py): N
+# independent runs vmap-stacked on a leading tenant axis lay out on a
+# (TENANT, POP) 2-D mesh — tenant-leading leaves sharded over "tenant",
+# per-individual leaves over ("tenant", "pop").
+TENANT_AXIS = "tenant"
 
 __all__ = [
     "POP_AXIS",
+    "TENANT_AXIS",
     "create_mesh",
     "pop_sharding",
     "replicated_sharding",
     "shard_pop",
     "place_pop",
     "replicate",
+    "match_partition_rules",
     "state_sharding",
     "constrain_state",
     "place_state",
@@ -112,7 +120,87 @@ def _spec_for_path(state: Any, path: tuple, default: "P") -> "P":
     return spec
 
 
-def state_sharding(state: Any, mesh: Mesh, default: Optional["P"] = None) -> Any:
+def match_partition_rules(
+    rules: Sequence[Tuple[str, "P"]],
+    tree: Any,
+    default: Optional["P"] = None,
+    strict: bool = False,
+) -> Any:
+    """A pytree of ``PartitionSpec`` assigned by REGEX RULES over leaf key
+    paths — the rule-driven alternative to per-field annotations (the
+    ``match_partition_rules`` pattern of LLM sharding stacks, SNIPPETS.md
+    [2]), for states whose layout the annotations don't (or shouldn't)
+    describe: tenant-stacked fleets, externally defined pytrees, one-off
+    layout experiments.
+
+    ``rules``: ``[(pattern, spec), ...]`` tried in order against each
+    leaf's ``jax.tree_util.keystr`` path (``re.search`` semantics, so
+    ``r"\\.population$"`` anchors a suffix and ``r"algo"`` matches
+    anywhere); the FIRST match wins. Scalar (0-d) leaves always resolve
+    to ``P()`` — there is nothing to partition. Unmatched leaves get
+    ``default`` (``None`` keeps them unconstrained / GSPMD-propagated);
+    ``strict=True`` raises on an unmatched leaf instead, the
+    exhaustiveness check of the exemplar.
+
+    Returns a pytree of ``PartitionSpec``/``None`` matching ``tree`` —
+    feed it to :func:`constrain_state` (``rules=`` takes the raw rule
+    list directly), ``jax.device_put`` via ``NamedSharding``, or jit's
+    ``in_shardings``."""
+    resolve = _rule_resolver(rules)
+
+    def assign(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return P()
+        spec = resolve(path, leaf)
+        if spec is not None:
+            return spec
+        if strict:
+            raise ValueError(
+                "no partition rule matched leaf "
+                f"{jax.tree_util.keystr(path)!r}"
+            )
+        return default
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def _rule_resolver(rules: Optional[Sequence[Tuple[str, "P"]]]):
+    """Compile ``rules`` into ``path -> spec | None`` (None = no match)."""
+    if not rules:
+        return lambda path, leaf: None
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def resolve(path, leaf):
+        if getattr(leaf, "ndim", None) == 0:
+            return P()
+        name = jax.tree_util.keystr(path)
+        for pat, spec in compiled:
+            if pat.search(name) is not None:
+                return spec
+        return None
+
+    return resolve
+
+
+def _prefix_spec(spec: "P", leaf: Any, axis_prefix: Optional[str]) -> "P":
+    """Shift ``spec`` one axis right under ``axis_prefix`` (the stacked-
+    state law: ``P("pop")`` -> ``P(prefix, "pop")``, ``P()`` ->
+    ``P(prefix)``); leaves too narrow for the inner spec fall back to
+    prefix-only (or fully replicated for scalars)."""
+    if axis_prefix is None or axis_prefix in spec:
+        return spec
+    if getattr(leaf, "ndim", 0) < 1 + len(spec):
+        return P(axis_prefix) if getattr(leaf, "ndim", 0) >= 1 else P()
+    return P(axis_prefix, *spec)
+
+
+def state_sharding(
+    state: Any,
+    mesh: Mesh,
+    default: Optional["P"] = None,
+    rules: Optional[Sequence[Tuple[str, "P"]]] = None,
+    axis_prefix: Optional[str] = None,
+) -> Any:
     """A pytree of ``NamedSharding`` matching ``state``, driven by the
     ``field(sharding=...)`` annotations on its dataclasses (unannotated
     fields get ``default``, replicated unless overridden).
@@ -121,16 +209,30 @@ def state_sharding(state: Any, mesh: Mesh, default: Optional["P"] = None) -> Any
     (reference state.py:304-334 ``get_state_sharding`` exists but
     StdWorkflow ignores it): feed the result to ``jax.device_put``,
     ``with_sharding_constraint`` or jit's ``in_shardings``.
+
+    ``rules`` / ``axis_prefix``: same semantics as
+    :func:`constrain_state` — regex rules override annotations per leaf
+    path, and every resolved spec is shifted under ``axis_prefix``
+    (tenant-stacked fleet states, :mod:`evox_tpu.workflows.tenancy`).
     """
     default = P() if default is None else default
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, _spec_for_path(state, path, default)),
-        state,
-    )
+    rule_spec = _rule_resolver(rules)
+
+    def resolve(path, leaf):
+        spec = rule_spec(path, leaf)
+        if spec is None:
+            spec = _spec_for_path(state, path, default)
+        return NamedSharding(mesh, _prefix_spec(spec, leaf, axis_prefix))
+
+    return jax.tree_util.tree_map_with_path(resolve, state)
 
 
 def constrain_state(
-    state: Any, mesh: Optional[Mesh], policy: Any = None
+    state: Any,
+    mesh: Optional[Mesh],
+    policy: Any = None,
+    rules: Optional[Sequence[Tuple[str, "P"]]] = None,
+    axis_prefix: Optional[str] = None,
 ) -> Any:
     """Tracing-time: constrain ANNOTATED leaves to their declared sharding.
 
@@ -146,31 +248,59 @@ def constrain_state(
     reduction already ran in the compute dtype (see core/dtype_policy.py).
     ``policy=None`` (or a no-op policy) changes nothing, and a policy
     applies even without a mesh (single-device bf16 storage is the same
-    bytes win)."""
+    bytes win).
+
+    ``rules``: optional ``[(regex, PartitionSpec), ...]`` matched against
+    leaf key paths BEFORE the field annotations (first match wins; see
+    :func:`match_partition_rules`) — the escape hatch for layouts the
+    annotations don't describe.
+
+    ``axis_prefix``: prepend a mesh axis to every resolved spec —
+    ``P(POP_AXIS)`` becomes ``P(axis_prefix, POP_AXIS)`` and ``P()``
+    becomes ``P(axis_prefix)``. This is how a TENANT-stacked state (every
+    leaf grew a leading tenant axis, :mod:`evox_tpu.workflows.tenancy`)
+    reuses the per-field annotations unchanged on a (TENANT, POP) 2-D
+    mesh: the stacking axis shards over ``axis_prefix`` while each
+    field's own layout shifts one axis right — no per-state annotation
+    churn. Ignored for specs already naming the prefix axis."""
     from .dtype_policy import _castable, _storage_flag_for_path
 
     active = policy is not None and not policy.is_noop
     if mesh is None and not active:
         return state
+    rule_spec = _rule_resolver(rules)
 
     def constrain(path, x):
         if active and _castable(x) and _storage_flag_for_path(state, path):
             x = jax.lax.convert_element_type(x, policy.storage)
         if mesh is None:
             return x
-        spec = _spec_for_path(state, path, None)
+        spec = rule_spec(path, x)
+        if spec is None:
+            spec = _spec_for_path(state, path, None)
         if spec is None:
             return x
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, _prefix_spec(spec, x, axis_prefix))
+        )
 
     return jax.tree_util.tree_map_with_path(constrain, state)
 
 
-def place_state(state: Any, mesh: Optional[Mesh]) -> Any:
-    """Eager: ``device_put`` every leaf onto its annotated sharding."""
+def place_state(
+    state: Any,
+    mesh: Optional[Mesh],
+    rules: Optional[Sequence[Tuple[str, "P"]]] = None,
+    axis_prefix: Optional[str] = None,
+) -> Any:
+    """Eager: ``device_put`` every leaf onto its annotated sharding
+    (``rules``/``axis_prefix`` as :func:`state_sharding` — the restore
+    path for tenant-stacked fleet snapshots)."""
     if mesh is None:
         return state
-    shardings = state_sharding(state, mesh)
+    shardings = state_sharding(
+        state, mesh, rules=rules, axis_prefix=axis_prefix
+    )
     return jax.tree.map(jax.device_put, state, shardings)
 
 

@@ -504,19 +504,34 @@ def run_report(
     """
     # v2: roofline sections carry dtype_policy + donation provenance
     # (tools/check_report.py enforces them for v2+, exempting the
-    # historical v1 captures)
-    report: dict = {"schema": "evox_tpu.run_report/v2"}
+    # historical v1 captures). v3 adds the optional `tenancy` section
+    # (multi-tenant fleets, workflows/tenancy.py) — per-tenant monitor
+    # reports + fleet shape, validated when present.
+    report: dict = {"schema": "evox_tpu.run_report/v3"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
         telemetry = []
-        for i, mon in enumerate(getattr(workflow, "monitors", ())):
-            if hasattr(mon, "report"):
-                entry = mon.report(state.monitors[i])
-                entry["monitor"] = type(mon).__name__
-                entry["monitor_index"] = i
-                telemetry.append(entry)
+        # a fleet state (VectorizedWorkflowState) has no top-level
+        # .monitors — its per-tenant monitor states live tenant-stacked
+        # under .tenants and are reported through the tenancy section
+        mstates = getattr(state, "monitors", None)
+        if mstates is not None:
+            for i, mon in enumerate(getattr(workflow, "monitors", ())):
+                if hasattr(mon, "report"):
+                    entry = mon.report(mstates[i])
+                    entry["monitor"] = type(mon).__name__
+                    entry["monitor_index"] = i
+                    telemetry.append(entry)
         report["telemetry"] = telemetry
+        # multi-tenant fleets (duck-typed, core never imports workflows):
+        # per-tenant telemetry rings, fleet shape, and — when a RunQueue
+        # drives the fleet — the queue's admission/eviction counters
+        if hasattr(workflow, "tenancy_report"):
+            try:
+                report["tenancy"] = workflow.tenancy_report(state)
+            except Exception as e:  # report decoration must never sink it
+                report["tenancy"] = {"error": f"{type(e).__name__}: {e}"}
         # guarded runs (core/guardrail.py): surface the wrapper's health
         # counters as a first-class section (duck-typed — core stays
         # decoupled from the concrete GuardedAlgorithm class)
@@ -715,7 +730,11 @@ def write_chrome_trace(
                 )
 
     window_s = max(t_end - t0, 0.0)
-    if workflow is not None and state is not None:
+    if (
+        workflow is not None
+        and state is not None
+        and getattr(state, "monitors", None) is not None
+    ):
         events.append(meta(1, "device telemetry"))
         for i, mon in enumerate(getattr(workflow, "monitors", ())):
             tracks_fn = getattr(mon, "counter_tracks", None)

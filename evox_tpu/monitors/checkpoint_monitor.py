@@ -34,6 +34,12 @@ from .common import backend_supports_callbacks, host0_sharding
 
 
 class CheckpointMonitor(Monitor):
+    # convention flag: this monitor streams through host callbacks
+    # (io_callback/pure_callback) inside the traced step — consumed by
+    # surfaces that cannot host callbacks at all (VectorizedWorkflow
+    # fleets: a callback cannot run under vmap on ANY backend)
+    uses_host_callbacks = True
+
     def __init__(self, directory: str, every: int = 10, keep: int = 3):
         if every < 1:
             raise ValueError(f"every must be >= 1, got {every}")

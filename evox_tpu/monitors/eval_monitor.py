@@ -95,6 +95,11 @@ class EvalMonitor(Monitor):
         self.fitness_history: list = []
         self.solution_history: list = []
         self.opt_direction = jnp.ones((1,), dtype=jnp.float32)
+        # full histories stream through a host callback inside the step
+        # (the convention flag VectorizedWorkflow fleets reject — a
+        # callback cannot run under vmap); the on-device ring
+        # (history_capacity=K) stays fleet-safe
+        self.uses_host_callbacks = bool(full_fit_history or full_sol_history)
 
     def hooks(self):
         return ("post_eval",)

@@ -38,6 +38,12 @@ class EvoXVisMonitor(Monitor):
         record_time: store per-generation wall-clock offsets.
         compression: ``None`` | ``"lz4"`` | ``"zstd"``.
     """
+    # convention flag: this monitor streams through host callbacks
+    # (io_callback/pure_callback) inside the traced step — consumed by
+    # surfaces that cannot host callbacks at all (VectorizedWorkflow
+    # fleets: a callback cannot run under vmap on ANY backend)
+    uses_host_callbacks = True
+
 
     def __init__(
         self,

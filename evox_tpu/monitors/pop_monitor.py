@@ -21,6 +21,12 @@ from .common import host0_sharding
 
 
 class PopMonitor(Monitor):
+    # convention flag: this monitor streams through host callbacks
+    # (io_callback/pure_callback) inside the traced step — consumed by
+    # surfaces that cannot host callbacks at all (VectorizedWorkflow
+    # fleets: a callback cannot run under vmap on ANY backend)
+    uses_host_callbacks = True
+
     def __init__(
         self,
         population_name: str = "population",

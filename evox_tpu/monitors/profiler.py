@@ -34,6 +34,12 @@ class StepTimerMonitor(Monitor):
     a pointer to the callback-free alternatives instead of the opaque
     trace-time error the raw ``io_callback`` would produce.
     """
+    # convention flag: this monitor streams through host callbacks
+    # (io_callback/pure_callback) inside the traced step — consumed by
+    # surfaces that cannot host callbacks at all (VectorizedWorkflow
+    # fleets: a callback cannot run under vmap on ANY backend)
+    uses_host_callbacks = True
+
 
     def __init__(self):
         self.start_times: list = []

@@ -6,6 +6,12 @@ from .checkpoint import (
 from .std import StdWorkflow, StdWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
 from .pipelined import run_host_pipelined
+from .tenancy import (
+    RunQueue,
+    TenantSpec,
+    VectorizedWorkflow,
+    VectorizedWorkflowState,
+)
 from .supervisor import (
     DispatchDeadlineError,
     RunAbortedError,
@@ -18,6 +24,10 @@ __all__ = [
     "StdWorkflowState",
     "IslandWorkflow",
     "IslandWorkflowState",
+    "VectorizedWorkflow",
+    "VectorizedWorkflowState",
+    "RunQueue",
+    "TenantSpec",
     "WorkflowCheckpointer",
     "CheckpointConfigError",
     "restore_layouts",

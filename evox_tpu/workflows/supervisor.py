@@ -552,6 +552,13 @@ class RunSupervisor:
             snapshot = ckpt.latest(expect_like=expect_like)
             if snapshot is None:
                 return None
+            # tenant-stacked fleet states re-place by their own prefixed
+            # layout (VectorizedWorkflow.place_restored) — the plain
+            # annotation walk would shard a stacked leaf's TENANT axis
+            # over the pop mesh axis
+            placer = getattr(wf, "place_restored", None)
+            if placer is not None:
+                return placer(snapshot)
             return restore_layouts(snapshot, mesh=getattr(wf, "mesh", None))
 
         return restore

@@ -1,0 +1,1100 @@
+"""Multi-tenant run serving: vmapped strategy fleets and a RunQueue.
+
+The "millions of users" workload (ROADMAP north star) is thousands of
+*small independent searches*, not one big one — and a Python loop of
+solo :class:`~evox_tpu.workflows.std.StdWorkflow` runs pays a dispatch,
+a compile cache lookup, and (on the tunneled axon backend) a 45-100 ms
+round-trip PER RUN PER CHUNK. evosax (PAPERS.md, arXiv 2212.04180)
+proved the fix for JAX ES: ``vmap`` whole strategies so N runs become
+ONE fused XLA program; Fiber (PAPERS.md) showed population-of-runs
+serving is the shape PBT/RL fleets need. evox_tpu's frozen-``PyTreeNode``
+states stack trivially under ``vmap``, so this module makes fleets a
+first-class workflow:
+
+- :class:`VectorizedWorkflow` — N instances of the SAME algorithm class
+  (stacked hyperparameters, seeds, and per-tenant problem states with a
+  shared shape) vmapped into one jitted ``step`` and one fused ``run``
+  dispatch. Reuses the existing machinery wholesale: the
+  ``make_run_loop``/``fused_run`` fori-loop (one compile covers every
+  trip count, carry donation via ``donate_carries=``), ``DtypePolicy``
+  bf16 storage, ``quarantine_nonfinite``, monitors (vmapped per-tenant
+  rings), checkpointer/supervisor chunking, and ``GuardedAlgorithm``
+  (the wrapper's ask/tell vmap like any algorithm's).
+- A (TENANT, POP) 2-D mesh layout: the per-field
+  ``field(sharding=...)`` annotations are reused unchanged —
+  ``constrain_state(axis_prefix=TENANT_AXIS)`` shifts each spec one
+  axis right under the tenant axis (``P("pop")`` → ``P("tenant",
+  "pop")``, ``P()`` → ``P("tenant")``), and regex ``rules=`` (the
+  ``match_partition_rules`` pattern, SNIPPETS.md [2]) override leaves
+  the annotations don't describe. No reference analog; this is the
+  refactor unlock for ROADMAP items 4 (tenants × big pops) and 5 (PBT).
+- :class:`RunQueue` — the service layer on top: submit
+  :class:`TenantSpec` jobs beyond the fleet capacity, run in supervised
+  dispatch chunks (:class:`~evox_tpu.workflows.supervisor.RunSupervisor`
+  deadlines/retry/restore apply to the whole fleet dispatch), retire
+  tenants when their generation budget completes, admit pending specs
+  into the freed slot WITHOUT recompiling (state surgery at fixed
+  shapes), and evict mid-run — an eviction yields a single-tenant
+  checkpoint that a solo ``StdWorkflow`` resumes
+  (:meth:`VectorizedWorkflow.extract_tenant` /
+  :meth:`VectorizedWorkflow.solo_workflow`).
+
+Correctness contract (tests/test_tenancy.py): tenant ``i`` of a fleet
+reproduces a solo run of the same (algorithm, seed, hyperparams) —
+bitwise where vmap preserves XLA codegen, else within a documented
+tolerance (vmap batches matmuls/reductions, which can re-associate at
+the last ulp) plus the standard convergence-threshold gates; an evicted
+tenant's checkpoint resumed solo reproduces the remaining trajectory;
+supervisor chaos laws (retry/restore are replays of immutable states)
+hold through the fleet path.
+
+Scope: fleets require a JITTABLE problem (a host-callback ``evaluate``
+cannot run under ``vmap``; serve host problems with
+``run_host_pipelined`` per run, or wrap them jittable). Hyperparameters
+are bound as attributes on a shallow copy of the template algorithm
+inside the traced step, so only values the algorithm reads in
+``init``/``ask``/``tell`` can vary per tenant — derived quantities baked
+at construction (optax optimizer closures, CMA recombination weights)
+do not re-derive; shapes (``pop_size``, ``dim``) must be shared.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from functools import partial
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as _SpecP
+
+from ..core.algorithm import Algorithm
+from ..core.distributed import (
+    POP_AXIS as _POP,
+    TENANT_AXIS as _TENANT,
+    constrain_state,
+)
+from ..core.dtype_policy import DtypePolicy, apply_compute, apply_storage
+from ..core.monitor import Monitor
+from ..core.problem import Problem
+from ..core.struct import PyTreeNode, field, static_field
+from ..utils.common import parse_opt_direction
+from .checkpoint import (
+    WorkflowCheckpointer,
+    _as_checkpointer,
+    checkpointed_run,
+    resolve_resume,
+)
+from .common import (
+    build_hook_table,
+    fused_run,
+    make_run_loop,
+    quarantine_nonfinite,
+    run_hooks,
+)
+from .std import StdWorkflow, StdWorkflowState
+
+__all__ = [
+    "TenantState",
+    "VectorizedWorkflow",
+    "VectorizedWorkflowState",
+    "TenantSpec",
+    "RunQueue",
+]
+
+
+class TenantState(PyTreeNode):
+    """One tenant's slice of the fleet (every leaf is tenant-stacked in
+    the live :class:`VectorizedWorkflowState`). Mirrors
+    ``StdWorkflowState``'s (generation, algo, prob, monitors) plus the
+    tenant's traced hyperparameter bindings. ``generation`` is the
+    tenant's OWN counter — it differs from the fleet's lockstep counter
+    for tenants a RunQueue admitted mid-run, and it is what generation-
+    gated monitor hooks and eviction checkpoints see."""
+
+    generation: jax.Array = field(sharding=_SpecP())
+    algo: Any = None
+    prob: Any = None
+    monitors: Tuple[Any, ...] = ()
+    hyperparams: Dict[str, Any] = field(default_factory=dict)
+
+
+class VectorizedWorkflowState(PyTreeNode):
+    generation: jax.Array  # scalar: the fleet steps in lockstep
+    tenants: TenantState  # leaves carry a leading (n_tenants,) axis
+    first_step: bool = static_field(default=True)
+
+
+def _tenant_keys(key: jax.Array, n: int) -> jax.Array:
+    """Accept one key (split per tenant) or an already-stacked (n, ...)
+    key batch — the stacked form is how fleet-vs-solo equivalence tests
+    hand tenant ``i`` exactly the key its solo run would get."""
+    key = jnp.asarray(key)
+    typed = jnp.issubdtype(key.dtype, jax.dtypes.prng_key)
+    if (typed and key.ndim >= 1) or (not typed and key.ndim >= 2):
+        if key.shape[0] != n:
+            raise ValueError(
+                f"stacked key batch has leading axis {key.shape[0]}, "
+                f"expected n_tenants={n}"
+            )
+        return key
+    return jax.random.split(key, n)
+
+
+class VectorizedWorkflow:
+    """Vmap N instances of one algorithm class into ONE fused dispatch.
+
+    Args:
+        algorithm: the template :class:`Algorithm`. Static shape
+            hyperparameters (``pop_size``, ``dim``) are shared by every
+            tenant; per-tenant variation comes from ``hyperparams`` and
+            the per-tenant PRNG keys.
+        problem: a JITTABLE :class:`Problem`, shared evaluate; each
+            tenant gets its own problem STATE (vmapped ``init``), so
+            keyed/stochastic problems differ per tenant.
+        n_tenants: fleet width. Static — a different width is a new
+            compiled program (exactly like a different pop_size).
+        hyperparams: ``{name: stacked_value}`` — each value's leading
+            axis is ``n_tenants`` and ``name`` is an attribute (or
+            dotted path, e.g. ``"algorithm.noise_stdev"`` through a
+            :class:`~evox_tpu.core.guardrail.GuardedAlgorithm`) on the
+            template. Inside the traced step each tenant's slice is
+            bound onto a shallow copy of the template, so the value
+            flows through the tenant's ``init``/``ask``/``tell`` math
+            as a traced operand. Only attributes the algorithm READS in
+            those methods take effect (constructor-derived closures,
+            e.g. an optax optimizer's baked learning rate, do not).
+        monitors: shared monitor OBJECTS whose states are vmapped —
+            each tenant gets its own TelemetryMonitor ring / EvalMonitor
+            device archive. Monitors that stream through host callbacks
+            (CheckpointMonitor, StepTimerMonitor, PopMonitor,
+            EvoXVisMonitor, EvalMonitor full histories) are REJECTED at
+            construction — a callback cannot run inside the vmapped
+            step on any backend.
+        opt_direction / pop_transforms / fit_transforms /
+        quarantine_nonfinite: as :class:`StdWorkflow`, applied PER
+            TENANT (a rank transform ranks within each tenant's batch).
+        mesh: a mesh carrying a ``"tenant"`` axis (and usually a
+            ``"pop"`` axis): ``create_mesh((TENANT_AXIS, POP_AXIS),
+            shape=(t, p))``. Tenant-stacked state lays out by the
+            per-field annotations shifted under the tenant axis
+            (``constrain_state(axis_prefix=TENANT_AXIS)``); candidates
+            and fitness are sharded ``P(TENANT_AXIS, POP_AXIS)`` /
+            ``P(TENANT_AXIS)``.
+        rules: optional ``[(regex, PartitionSpec), ...]`` overriding the
+            annotation-derived layout per leaf path
+            (:func:`~evox_tpu.core.distributed.match_partition_rules`
+            semantics; matched against the TENANT-STACKED state's key
+            paths, e.g. ``r"\\.algo\\.population$"``).
+        dtype_policy / donate_carries / jit_step: as
+            :class:`StdWorkflow` — the policy's storage downcast and
+            the donated fused-run carry apply to the whole stacked
+            state (the bytes win multiplies by N).
+    """
+
+    def __init__(
+        self,
+        algorithm: Algorithm,
+        problem: Problem,
+        n_tenants: int,
+        hyperparams: Optional[Dict[str, Any]] = None,
+        monitors: Sequence[Monitor] = (),
+        opt_direction: Any = "min",
+        pop_transforms: Sequence[Callable] = (),
+        fit_transforms: Sequence[Callable] = (),
+        mesh: Optional[jax.sharding.Mesh] = None,
+        rules: Optional[Sequence[Tuple[str, Any]]] = None,
+        num_objectives: int = 1,
+        jit_step: bool = True,
+        quarantine_nonfinite: bool = False,
+        dtype_policy: Optional[DtypePolicy] = None,
+        donate_carries: bool = False,
+    ):
+        if n_tenants < 1:
+            raise ValueError(f"n_tenants must be >= 1, got {n_tenants}")
+        if not problem.jittable:
+            raise ValueError(
+                "VectorizedWorkflow requires a jittable problem: a host "
+                "pure_callback cannot run under vmap. Serve host problems "
+                "one run at a time (run_host_pipelined), or wrap the "
+                "evaluation jittable."
+            )
+        self.algorithm = algorithm
+        self.problem = problem
+        self.n_tenants = n_tenants
+        self.monitors = tuple(monitors)
+        self._opt_direction_arg = opt_direction
+        self.opt_direction = parse_opt_direction(opt_direction)
+        self.pop_transforms = tuple(pop_transforms)
+        self.fit_transforms = tuple(fit_transforms)
+        self.mesh = mesh
+        self.rules = tuple(rules) if rules else None
+        self.num_objectives = num_objectives
+        self.quarantine_nonfinite = quarantine_nonfinite
+        self.dtype_policy = dtype_policy
+        self.jit_step = jit_step
+        self.donate_carries = bool(donate_carries) and jit_step
+        self.external = False  # fused_run/instrument duck-typing parity
+        for m in self.monitors:
+            if getattr(m, "uses_host_callbacks", False):
+                raise ValueError(
+                    f"{type(m).__name__} streams through host callbacks, "
+                    "which cannot run inside the vmapped fleet step on ANY "
+                    "backend; use the callback-free monitors for per-tenant "
+                    "history (TelemetryMonitor rings, "
+                    "EvalMonitor(history_capacity=K))"
+                )
+        self.hyperparams = self._check_hyperparams(hyperparams or {})
+        if mesh is not None:
+            if _TENANT not in mesh.axis_names:
+                raise ValueError(
+                    f"VectorizedWorkflow mesh must carry a '{_TENANT}' "
+                    f"axis (got axes {tuple(mesh.axis_names)}); build it "
+                    "with create_mesh((TENANT_AXIS, POP_AXIS), shape=(t, p))"
+                )
+            t_shards = mesh.shape[_TENANT]
+            if n_tenants % t_shards != 0:
+                raise ValueError(
+                    f"n_tenants {n_tenants} is not divisible by the mesh's "
+                    f"'{_TENANT}' axis ({t_shards} shards)"
+                )
+            pop_size = getattr(algorithm, "pop_size", None)
+            p_shards = mesh.shape.get(_POP, 1)
+            if pop_size is not None and pop_size % p_shards != 0:
+                raise ValueError(
+                    f"pop_size {pop_size} is not divisible by the mesh's "
+                    f"'{_POP}' axis ({p_shards} shards)"
+                )
+        for m in self.monitors:
+            m.set_opt_direction(self.opt_direction)
+        self._hook_table = build_hook_table(self.monitors)
+        self._step = jax.jit(self._step_impl) if jit_step else self._step_impl
+        self._run_loop = make_run_loop(self._step_impl, donate=self.donate_carries)
+        # single-tenant first-generation peel for RunQueue admission:
+        # hyperparams are TRACED leaves of the TenantState operand, so
+        # ONE compile serves every admitted spec regardless of its
+        # bindings (a per-admission solo StdWorkflow would recompile)
+        self._solo_peel = (
+            jax.jit(self._solo_peel_impl) if jit_step else self._solo_peel_impl
+        )
+
+    # ------------------------------------------------------------ hyperparams
+    def _check_hp_name(self, name: str) -> None:
+        """Validate a (possibly dotted) hyperparam attribute path against
+        the template — the one resolution rule shared by the constructor
+        stack, ``init_tenant``, and RunQueue admission."""
+        obj = self.algorithm
+        for part in name.split("."):
+            if not hasattr(obj, part):
+                raise ValueError(
+                    f"hyperparams[{name!r}]: template "
+                    f"{type(obj).__name__} has no attribute {part!r}"
+                )
+            obj = getattr(obj, part)
+
+    def _check_hyperparams(self, hp: Dict[str, Any]) -> Dict[str, Any]:
+        checked = {}
+        for name, value in hp.items():
+            self._check_hp_name(name)
+            value = jnp.asarray(value)
+            if value.ndim < 1 or value.shape[0] != self.n_tenants:
+                raise ValueError(
+                    f"hyperparams[{name!r}] must be stacked with leading "
+                    f"axis n_tenants={self.n_tenants}, got shape "
+                    f"{value.shape}"
+                )
+            checked[name] = value
+        return checked
+
+    def _bind(self, hp: Dict[str, Any]) -> Algorithm:
+        """A shallow copy of the template with this tenant's hyperparam
+        slices bound as attributes (dotted paths copy-on-write each
+        intermediate object, so a ``GuardedAlgorithm``'s inner algorithm
+        is copied before its attribute is rebound)."""
+        if not hp:
+            return self.algorithm
+        root = copy.copy(self.algorithm)
+        fresh: Dict[str, Any] = {}
+        for name, value in hp.items():
+            obj = root
+            parts = name.split(".")
+            for depth, part in enumerate(parts[:-1]):
+                prefix = ".".join(parts[: depth + 1])
+                child = fresh.get(prefix)
+                if child is None:
+                    child = copy.copy(getattr(obj, part))
+                    fresh[prefix] = child
+                    setattr(obj, part, child)
+                obj = child
+            setattr(obj, parts[-1], value)
+        return root
+
+    def tenant_hyperparams(
+        self, index: int, state: Optional[VectorizedWorkflowState] = None
+    ) -> Dict[str, Any]:
+        """Tenant ``index``'s concrete hyperparam bindings (host values).
+        Reads the LIVE state's bindings when given (a RunQueue rebinds
+        slots on admission), else the constructor stack."""
+        source = (
+            state.tenants.hyperparams if state is not None else self.hyperparams
+        )
+        return {
+            name: jax.device_get(value)[index]
+            for name, value in source.items()
+        }
+
+    # ------------------------------------------------------------------ init
+    def init(
+        self, key: jax.Array, hyperparams: Optional[Dict[str, Any]] = None
+    ) -> VectorizedWorkflowState:
+        """Build the fleet state. ``key``: one key (split per tenant) or
+        a stacked ``(n_tenants, ...)`` key batch. Each tenant's slice is
+        initialized EXACTLY like ``StdWorkflow.init`` with that tenant's
+        key (same split discipline), so tenant ``i`` starts bit-identical
+        to a solo run seeded with key ``i``. ``hyperparams=`` overrides
+        the constructor stack (same names/shapes) — the RunQueue's
+        admission path."""
+        hp = (
+            self.hyperparams
+            if hyperparams is None
+            else self._check_hyperparams(hyperparams)
+        )
+        keys = _tenant_keys(key, self.n_tenants)
+        tenants = jax.vmap(self._build_tenant)(keys, hp)
+        state = VectorizedWorkflowState(
+            generation=jnp.zeros((), dtype=jnp.int32),
+            tenants=tenants,
+            first_step=True,
+        )
+        return apply_storage(state, self.dtype_policy)
+
+    def _build_tenant(self, k: jax.Array, h: Dict[str, Any]) -> TenantState:
+        """The single-tenant constructor shared by the vmapped fleet
+        ``init`` and ``init_tenant`` — ONE key-split discipline (matching
+        ``StdWorkflow.init``), so the fleet-vs-solo and admission
+        equivalence contracts cannot drift apart."""
+        algo = self._bind(h)
+        ks = jax.random.split(k, 2 + len(self.monitors))
+        return TenantState(
+            generation=jnp.zeros((), dtype=jnp.int32),
+            algo=algo.init(ks[0]),
+            prob=self.problem.init(ks[1]),
+            monitors=tuple(
+                m.init(kk) for m, kk in zip(self.monitors, ks[2:])
+            ),
+            hyperparams=h,
+        )
+
+    # ------------------------------------------------------------------ step
+    def step(self, state: VectorizedWorkflowState) -> VectorizedWorkflowState:
+        return self._step(state)
+
+    def run(
+        self,
+        state: VectorizedWorkflowState,
+        n_steps: int,
+        checkpointer: Optional[WorkflowCheckpointer] = None,
+        resume_from: Any = None,
+    ) -> VectorizedWorkflowState:
+        """Run ``n_steps`` generations of the WHOLE fleet as one fused
+        ``fori_loop`` dispatch (see :meth:`StdWorkflow.run` — same
+        checkpointer/resume laws, applied to the fleet state; the
+        supervisor drives this entry point for chunked healing)."""
+        if resume_from is not None:
+            state, n_steps = resolve_resume(
+                resume_from, state, n_steps, expect_like=state
+            )
+            if checkpointer is None:
+                checkpointer = _as_checkpointer(resume_from)
+        if checkpointer is not None:
+            return checkpointed_run(self, state, n_steps, checkpointer)
+        return fused_run(self, state, n_steps)
+
+    def analysis_targets(self, state: VectorizedWorkflowState) -> dict:
+        """AOT cost/memory analysis targets (core/xla_cost.py): the
+        steady vmapped step and the fused fleet run (dynamic trip count
+        ⇒ statics are per fleet-generation), so
+        ``run_report()["roofline"]`` attributes the FUSED FLEET dispatch
+        — N tenants' achieved rates in one verdict."""
+        if not self.jit_step:
+            return {}
+        steady = state.replace(first_step=False) if state.first_step else state
+        return {
+            "step": (self._step, (steady,)),
+            "run": (self._run_loop, (steady, jnp.asarray(1, jnp.int32))),
+        }
+
+    # ------------------------------------------------------------- internals
+    def _flip(self, fitness: jax.Array) -> jax.Array:
+        if fitness.ndim == 1:
+            return fitness * self.opt_direction[0]
+        return fitness * self.opt_direction
+
+    def _shard_stacked(self, tree: Any, inner_pop: bool) -> Any:
+        """Constrain tenant-stacked candidate/fitness batches:
+        ``P(tenant, pop)`` for (N, B, ...) candidates, ``P(tenant)``
+        when the inner axis doesn't shard (scalar fitness rows)."""
+        if self.mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        has_pop = _POP in self.mesh.axis_names
+
+        def constrain(x):
+            if x.ndim >= 2 and has_pop and inner_pop:
+                spec = P(_TENANT, _POP)
+            else:
+                spec = P(_TENANT)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(self.mesh, spec)
+            )
+
+        return jax.tree.map(constrain, tree)
+
+    def _tenant_ask(self, t: TenantState, use_init: bool):
+        mstates = list(t.monitors)
+        run_hooks(self.monitors, self._hook_table, "pre_step", mstates)
+        run_hooks(self.monitors, self._hook_table, "pre_ask", mstates)
+        algo = self._bind(t.hyperparams)
+        ask = algo.init_ask if use_init else algo.ask
+        pop, astate = ask(t.algo)
+        run_hooks(self.monitors, self._hook_table, "post_ask", mstates, pop)
+        cand = pop
+        for tr in self.pop_transforms:
+            cand = tr(cand)
+        run_hooks(self.monitors, self._hook_table, "pre_eval", mstates, cand)
+        return cand, (astate, tuple(mstates))
+
+    def _tenant_tell(
+        self,
+        t: TenantState,
+        ctx,
+        cand: Any,
+        fitness: jax.Array,
+        pstate: Any,
+        use_init: bool,
+    ) -> TenantState:
+        astate, mstates_t = ctx
+        mstates = list(mstates_t)
+        run_hooks(
+            self.monitors, self._hook_table, "post_eval", mstates, cand, fitness
+        )
+        fitness = self._flip(fitness)
+        if self.quarantine_nonfinite:
+            fitness = quarantine_nonfinite(fitness)
+        for tr in self.fit_transforms:
+            fitness = tr(fitness)
+        run_hooks(self.monitors, self._hook_table, "pre_tell", mstates, fitness)
+        algo = self._bind(t.hyperparams)
+        tell = algo.init_tell if use_init else algo.tell
+        astate = tell(astate, fitness)
+        run_hooks(self.monitors, self._hook_table, "post_tell", mstates)
+        # post_step sees the documented workflow-state shape — a solo
+        # view with the tenant's OWN .generation (not the fleet's
+        # lockstep counter, which runs ahead for queue-admitted tenants)
+        # plus .algo/.prob/.monitors — so monitors written against
+        # StdWorkflow's contract (generation-gated savers, the guardrail
+        # mirror) trace identically per tenant
+        generation = t.generation + 1
+        hook_state = StdWorkflowState(
+            generation=generation,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(mstates),
+            first_step=False,
+        )
+        ms = list(mstates)
+        run_hooks(self.monitors, self._hook_table, "post_step", ms, hook_state)
+        return TenantState(
+            generation=generation,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(ms),
+            hyperparams=t.hyperparams,
+        )
+
+    def _step_impl(
+        self, state: VectorizedWorkflowState
+    ) -> VectorizedWorkflowState:
+        # storage -> compute upcast at the fleet step boundary, exactly
+        # like StdWorkflow: all per-tenant math runs in the compute dtype
+        state = apply_compute(state, self.dtype_policy)
+        use_init = state.first_step and (
+            self.algorithm.has_init_ask or self.algorithm.has_init_tell
+        )
+        tenants = state.tenants
+        cand, ctx = jax.vmap(partial(self._tenant_ask, use_init=use_init))(
+            tenants
+        )
+        # the whole fleet's candidates are ONE (N, B, ...) batch laid out
+        # over (TENANT, POP) — GSPMD partitions the vmapped evaluation
+        # across both axes from this single constraint
+        cand = self._shard_stacked(cand, inner_pop=True)
+        fitness, pstate = jax.vmap(self.problem.evaluate)(tenants.prob, cand)
+        fitness = self._shard_stacked(fitness, inner_pop=True)
+        tenants = jax.vmap(partial(self._tenant_tell, use_init=use_init))(
+            tenants, ctx, cand, fitness, pstate
+        )
+        # end-of-step boundary, fleet-wide: the per-field annotations are
+        # applied SHIFTED under the tenant axis (P("pop") -> P("tenant",
+        # "pop"), P() -> P("tenant")) with regex rules overriding, and an
+        # active dtype policy downcasts storage leaves in the same walk
+        tenants = constrain_state(
+            tenants,
+            self.mesh,
+            self.dtype_policy,
+            rules=self.rules,
+            axis_prefix=_TENANT,
+        )
+        return state.replace(
+            generation=state.generation + 1,
+            tenants=tenants,
+            first_step=False,
+        )
+
+    def init_tenant(
+        self, key: jax.Array, hyperparams: Optional[Dict[str, Any]] = None
+    ) -> TenantState:
+        """A fresh SINGLE tenant (unstacked :class:`TenantState`) with
+        concrete ``hyperparams`` bound — the RunQueue admission path.
+        Key-split discipline matches :meth:`init`'s per-tenant splits
+        (and therefore ``StdWorkflow.init``), so an admitted tenant is
+        trajectory-equivalent to a solo run of its (seed, bindings)."""
+        hp = {}
+        for name, value in (hyperparams or {}).items():
+            self._check_hp_name(name)
+            hp[name] = jnp.asarray(value)
+        return self._build_tenant(jnp.asarray(key), hp)
+
+    def _solo_peel_impl(self, t: TenantState) -> TenantState:
+        """One un-vmapped first generation of a single tenant (the
+        init_ask/init_tell dispatch the fleet's steady vmapped step must
+        never issue for one slot only). Hook order mirrors the vmapped
+        step exactly."""
+        cand, ctx = self._tenant_ask(t, use_init=True)
+        fitness, pstate = self.problem.evaluate(t.prob, cand)
+        return self._tenant_tell(t, ctx, cand, fitness, pstate, use_init=True)
+
+    def place_restored(self, state: VectorizedWorkflowState) -> Any:
+        """Eagerly re-place a host-restored FLEET snapshot on this
+        workflow's mesh using the tenant-prefixed layout (the fleet
+        analog of :func:`~evox_tpu.workflows.checkpoint.restore_layouts`
+        — the un-prefixed annotations would shard a stacked leaf's
+        TENANT axis over the ``pop`` mesh axis). The supervisor's
+        restore rung picks this up duck-typed."""
+        from ..core.distributed import place_state
+
+        if self.mesh is None:
+            return state
+        return place_state(
+            state, self.mesh, rules=self.rules, axis_prefix=_TENANT
+        )
+
+    # ------------------------------------------------- eviction / admission
+    def solo_workflow(
+        self,
+        index: Optional[int] = None,
+        hyperparams: Optional[Dict[str, Any]] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        state: Optional[VectorizedWorkflowState] = None,
+    ) -> StdWorkflow:
+        """A single-tenant :class:`StdWorkflow` equivalent to fleet slot
+        ``index`` (or to explicit concrete ``hyperparams``): the template
+        algorithm with that tenant's bindings baked in, the same problem,
+        monitors, transforms and dtype policy. This is the resume target
+        for an evicted tenant's checkpoint — and the reference
+        implementation the fleet's per-tenant trajectory is tested
+        against. Pass ``state=`` with ``index`` to read the LIVE slot
+        bindings (a RunQueue rebinds slots on admission, so the
+        constructor stack can be stale for queue-driven fleets)."""
+        if hyperparams is None:
+            hyperparams = (
+                self.tenant_hyperparams(index, state=state)
+                if index is not None
+                else {}
+            )
+        algo = self._bind(
+            {k: jnp.asarray(v) for k, v in hyperparams.items()}
+        )
+        return StdWorkflow(
+            algo,
+            self.problem,
+            monitors=self.monitors,
+            opt_direction=self._opt_direction_arg,
+            pop_transforms=self.pop_transforms,
+            fit_transforms=self.fit_transforms,
+            mesh=mesh,
+            num_objectives=self.num_objectives,
+            jit_step=self.jit_step,
+            quarantine_nonfinite=self.quarantine_nonfinite,
+            dtype_policy=self.dtype_policy,
+            donate_carries=self.donate_carries,
+        )
+
+    def extract_tenant(
+        self,
+        state: VectorizedWorkflowState,
+        index: int,
+        generation: Optional[int] = None,
+    ) -> StdWorkflowState:
+        """Slice tenant ``index`` out of the fleet as a SOLO
+        ``StdWorkflowState`` (host-side ``device_get`` + slice, eager —
+        call between dispatches). The result is exactly what
+        ``solo_workflow(index)`` would be carrying at this generation:
+        checkpoint it with a :class:`WorkflowCheckpointer` and the solo
+        workflow's ``resume_from=`` completes the run — the mid-fleet
+        eviction contract. ``generation`` overrides the tenant's own
+        counter (rarely needed — the state tracks it per tenant)."""
+        # slice ON DEVICE first: fetching the whole stacked fleet to
+        # discard N-1 tenants would cost N× the bytes per eviction (the
+        # tunnel charges ~6.6 s/256 MB, CLAUDE.md)
+        t = jax.device_get(
+            jax.tree.map(lambda x: x[index], state.tenants)
+        )
+        gen = int(t.generation) if generation is None else int(generation)
+        return StdWorkflowState(
+            generation=jnp.asarray(gen, dtype=jnp.int32),
+            algo=t.algo,
+            prob=t.prob,
+            monitors=t.monitors,
+            first_step=False,
+        )
+
+    def insert_tenant(
+        self,
+        state: VectorizedWorkflowState,
+        index: int,
+        solo_state: Any,
+        hyperparams: Optional[Dict[str, Any]] = None,
+    ) -> VectorizedWorkflowState:
+        """Write a solo tenant state into fleet slot ``index`` (state
+        surgery at fixed shapes — NO recompile: the fleet program only
+        sees different leaf values). ``solo_state``: a
+        ``StdWorkflowState`` (from ``solo_workflow(...).init`` or an
+        eviction checkpoint) or an unstacked :class:`TenantState` (from
+        :meth:`init_tenant`); it must match the fleet's per-tenant
+        structure (same algorithm class, pop size, monitor set).
+        ``hyperparams``: the slot's new concrete bindings (default: a
+        TenantState's own, else the slot's current ones). A solo state's
+        ``generation`` is the tenant's — the caller (RunQueue) tracks
+        the offset against the fleet's lockstep counter."""
+        if hyperparams is not None:
+            slot_hp = {
+                name: jnp.asarray(value)
+                for name, value in hyperparams.items()
+            }
+        elif isinstance(solo_state, TenantState):
+            slot_hp = solo_state.hyperparams
+        else:
+            slot_hp = jax.tree.map(
+                lambda x: x[index], state.tenants.hyperparams
+            )
+        new_t = TenantState(
+            generation=jnp.asarray(solo_state.generation, dtype=jnp.int32),
+            algo=solo_state.algo,
+            prob=solo_state.prob,
+            monitors=solo_state.monitors,
+            hyperparams=slot_hp,
+        )
+        new_t = apply_storage(new_t, self.dtype_policy)
+
+        def put(stacked, new):
+            stacked = jnp.asarray(stacked)
+            return stacked.at[index].set(
+                jnp.asarray(new, dtype=stacked.dtype)
+            )
+
+        return state.replace(
+            tenants=jax.tree.map(put, state.tenants, new_t)
+        )
+
+    # -------------------------------------------------------------- reporting
+    def monitor_reports(self, mstates: Tuple[Any, ...]) -> List[dict]:
+        """One monitor's ``report()`` per reporting monitor for a single
+        tenant's monitor states — the shared assembly behind the tenancy
+        section and the RunQueue's per-tenant results."""
+        reports = []
+        for j, mon in enumerate(self.monitors):
+            if hasattr(mon, "report"):
+                r = mon.report(mstates[j])
+                r["monitor"] = type(mon).__name__
+                reports.append(r)
+        return reports
+
+    def tenancy_report(self, state: VectorizedWorkflowState) -> dict:
+        """The ``tenancy`` section of ``run_report()``: fleet shape,
+        measured leading axes (the validator cross-checks them against
+        ``n_tenants``), and each tenant's monitor reports (per-tenant
+        telemetry rings). Host-side, strict JSON."""
+        from ..core.instrument import sanitize_json
+
+        # leading axes need SHAPES only (zero transfer); only the
+        # monitor states — the small rings — are fetched, never the
+        # stacked populations/covariances (tunnel bytes are the cost)
+        leading = {
+            int(x.shape[0])
+            for x in jax.tree.leaves(state.tenants.algo)
+            if getattr(x, "ndim", 0) >= 1
+        }
+        host_monitors = jax.device_get(state.tenants.monitors)
+        per_tenant = []
+        for i in range(self.n_tenants):
+            entry: dict = {"tenant": i}
+            reports = self.monitor_reports(
+                tuple(
+                    jax.tree.map(lambda x: x[i], ms) for ms in host_monitors
+                )
+            )
+            if reports:
+                entry["monitors"] = reports
+            per_tenant.append(entry)
+        report = {
+            "n_tenants": self.n_tenants,
+            "generation": int(state.generation),
+            "tenant_axis": _TENANT if self.mesh is not None else None,
+            "leading_axes": sorted(leading),
+            "per_tenant": per_tenant,
+        }
+        queue = getattr(self, "_run_queue", None)
+        if queue is not None and hasattr(queue, "report"):
+            report["queue"] = queue.report()
+        return sanitize_json(report)
+
+
+# --------------------------------------------------------------------- queue
+
+
+@dataclasses.dataclass
+class TenantSpec:
+    """One queued search: seed (int or PRNG key), concrete hyperparam
+    bindings (must use the fleet's hyperparam names), a generation
+    budget, and an optional tag for the results table."""
+
+    seed: Any
+    n_steps: int
+    hyperparams: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    tag: Optional[str] = None
+
+    def key(self) -> jax.Array:
+        import numpy as np
+
+        if isinstance(self.seed, (int, np.integer)):
+            return jax.random.PRNGKey(int(self.seed))
+        return jnp.asarray(self.seed)
+
+
+@dataclasses.dataclass
+class _Slot:
+    spec: TenantSpec
+    active: bool = True
+
+
+class RunQueue:
+    """Admit/evict tenants through a fixed-width vmapped fleet.
+
+    The fleet's width is static (a compiled-program shape); the queue
+    serves MORE searches than that by running the fleet in dispatch
+    chunks and swapping retired tenants for pending specs between
+    chunks — state surgery at fixed shapes, no recompile. With a
+    :class:`~evox_tpu.workflows.supervisor.RunSupervisor`, every chunk
+    dispatch runs under its deadline/retry/restore ladder (the fleet is
+    one workflow to the supervisor).
+
+    Args:
+        workflow: a :class:`VectorizedWorkflow`. Its constructor
+            hyperparam stack is only a default — each admitted spec's
+            bindings overwrite its slot.
+        chunk: generations per dispatch chunk (the admission/eviction
+            granularity). A tenant's budget is honored exactly: the
+            chunk is shortened when any active tenant would overshoot.
+        supervisor: optional :class:`RunSupervisor` driving each chunk.
+        checkpoint_dir: when given, every retirement/eviction writes a
+            resumable single-tenant snapshot under
+            ``<dir>/<tag-or-tenant_K>/`` (a
+            :class:`WorkflowCheckpointer`; ``solo_workflow(...)``
+            resumes it).
+        keep: snapshots kept per tenant directory.
+
+    Lifecycle: ``submit()`` specs (at least ``n_tenants`` before the
+    first ``start()``), then ``run()`` to completion — or ``start()`` +
+    repeated ``step_chunk()`` for between-chunk control (the legal
+    window for :meth:`evict`). Results accumulate in ``results``;
+    :meth:`report` is the ``tenancy.queue`` section of ``run_report``.
+    """
+
+    def __init__(
+        self,
+        workflow: VectorizedWorkflow,
+        chunk: int = 10,
+        supervisor: Any = None,
+        checkpoint_dir: Optional[str] = None,
+        keep: int = 2,
+    ):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.workflow = workflow
+        self.chunk = chunk
+        self.supervisor = supervisor
+        self.checkpoint_dir = (
+            Path(checkpoint_dir) if checkpoint_dir is not None else None
+        )
+        self.keep = keep
+        self.pending: List[TenantSpec] = []
+        self._used_dirs: set = set()
+        self.slots: List[Optional[_Slot]] = [None] * workflow.n_tenants
+        self.state: Optional[VectorizedWorkflowState] = None
+        self.results: List[dict] = []
+        self.counters = {
+            "submitted": 0,
+            "admitted": 0,
+            "retired": 0,
+            "evicted": 0,
+            "chunks": 0,
+        }
+        workflow._run_queue = self  # run_report pickup (tenancy.queue)
+
+    # ------------------------------------------------------------- lifecycle
+    def submit(self, spec: TenantSpec) -> None:
+        """Queue a spec. Validated HERE — a bad spec must be rejected at
+        the submission boundary, not discovered mid-sweep after it was
+        popped (which would lose it and leave the queue half-updated)."""
+        if spec.n_steps < 1:
+            raise ValueError(
+                f"TenantSpec.n_steps must be >= 1, got {spec.n_steps}"
+            )
+        if set(spec.hyperparams) != set(self.workflow.hyperparams):
+            raise ValueError(
+                f"spec hyperparams {sorted(spec.hyperparams)} must use "
+                f"exactly the fleet's hyperparam names "
+                f"{sorted(self.workflow.hyperparams)}"
+            )
+        for name in spec.hyperparams:
+            self.workflow._check_hp_name(name)
+        self.counters["submitted"] += 1
+        self.pending.append(spec)
+
+    def start(self) -> VectorizedWorkflowState:
+        """Fill every slot from the pending queue and init the fleet."""
+        wf = self.workflow
+        if self.state is not None:
+            raise RuntimeError("RunQueue already started")
+        if len(self.pending) < wf.n_tenants:
+            raise ValueError(
+                f"need at least n_tenants={wf.n_tenants} pending specs to "
+                f"fill the fleet, have {len(self.pending)}; submit more or "
+                "build a narrower fleet"
+            )
+        specs = [self.pending.pop(0) for _ in range(wf.n_tenants)]
+        keys = jnp.stack([s.key() for s in specs])
+        hp = self._stack_hp([s.hyperparams for s in specs])
+        self.state = wf.init(keys, hyperparams=hp)
+        self.slots = [_Slot(spec=s) for s in specs]
+        self.counters["admitted"] += len(specs)
+        return self.state
+
+    def _stack_hp(self, hp_dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+        names = set(self.workflow.hyperparams)
+        for d in hp_dicts:
+            if set(d) != names:
+                raise ValueError(
+                    f"spec hyperparams {sorted(d)} must use exactly the "
+                    f"fleet's hyperparam names {sorted(names)}"
+                )
+        return {
+            name: jnp.stack([jnp.asarray(d[name]) for d in hp_dicts])
+            for name in names
+        }
+
+    def _dispatch(self, n: int) -> None:
+        wf = self.workflow
+        if self.supervisor is not None:
+            self.state = self.supervisor.run(wf, self.state, n)
+        else:
+            self.state = wf.run(self.state, n)
+        self.counters["chunks"] += 1
+
+    def _tenant_generations(self):
+        """Per-slot OWN generation counters, read from the state (one
+        tiny (N,) int32 fetch — the authoritative ledger the budgets are
+        checked against)."""
+        import numpy as np
+
+        return np.asarray(jax.device_get(self.state.tenants.generation))
+
+    def _sweep(self):
+        """Retire every active tenant at/over budget, refill idle slots
+        from the pending queue (covers specs submitted after a previous
+        ``run()`` drained the fleet). Loops until stable: a freshly
+        admitted tenant whose solo peel already met a 1-generation
+        budget retires in the next pass instead of forcing a
+        zero-length dispatch. Returns the final per-slot generation
+        ledger so the caller doesn't refetch it."""
+        changed = True
+        gens = self._tenant_generations()
+        while changed:
+            changed = False
+            for i, slot in enumerate(self.slots):
+                if (
+                    slot is not None
+                    and slot.active
+                    and gens[i] >= slot.spec.n_steps
+                ):
+                    self._retire(i, status="completed")
+                    changed = True
+            for i, slot in enumerate(self.slots):
+                if (slot is None or not slot.active) and self.pending:
+                    self._refill(i)
+                    changed = True
+            if changed:
+                # surgery/retirement changed the ledger; refresh once
+                # per pass (the fetch is a tiny (N,) int32, but on the
+                # tunnel every round-trip counts)
+                gens = self._tenant_generations()
+        return gens
+
+    def step_chunk(self) -> bool:
+        """Run one dispatch chunk, retire/refill finished tenants.
+        Returns True while work remains (active tenants or pending
+        specs). Between calls is the legal window for :meth:`evict`."""
+        if self.state is None:
+            self.start()
+        gens = self._sweep()
+        active = [
+            (i, s) for i, s in enumerate(self.slots)
+            if s is not None and s.active
+        ]
+        if not active:
+            return False
+        n = min(
+            self.chunk,
+            min(s.spec.n_steps - gens[i] for i, s in active),
+        )
+        self._dispatch(n)
+        self._sweep()
+        return any(s is not None and s.active for s in self.slots) or bool(
+            self.pending
+        )
+
+    def run(self) -> List[dict]:
+        """Drive everything submitted so far to completion."""
+        if self.state is None:
+            self.start()
+        while self.step_chunk():
+            pass
+        return self.results
+
+    # ------------------------------------------------------- retire / evict
+    def _tenant_dir(self, slot: _Slot, index: int) -> Optional[Path]:
+        if self.checkpoint_dir is None:
+            return None
+        name = slot.spec.tag or f"tenant_{self.counters['retired'] + self.counters['evicted']:04d}_slot{index}"
+        # never share a snapshot directory between two close-outs: the
+        # config fingerprint cannot tell two same-shape searches apart,
+        # so a reused tag would let one tenant's snapshot silently
+        # shadow the other's on resume
+        if name in self._used_dirs:
+            seq = 2
+            while f"{name}_{seq}" in self._used_dirs:
+                seq += 1
+            name = f"{name}_{seq}"
+        self._used_dirs.add(name)
+        return self.checkpoint_dir / name
+
+    def _extract(self, index: int) -> StdWorkflowState:
+        # the tenant's own generation counter rides in the state itself
+        return self.workflow.extract_tenant(self.state, index)
+
+    def _close_out(self, index: int, status: str) -> dict:
+        slot = self.slots[index]
+        solo = self._extract(index)
+        entry: dict = {
+            "tag": slot.spec.tag,
+            "slot": index,
+            "status": status,
+            "generations": int(solo.generation),
+            "budget": slot.spec.n_steps,
+        }
+        tenant_dir = self._tenant_dir(slot, index)
+        if tenant_dir is not None:
+            ckpt = WorkflowCheckpointer(
+                str(tenant_dir), every=max(int(solo.generation), 1),
+                keep=self.keep,
+            )
+            ckpt.save(solo)
+            entry["checkpoint"] = str(tenant_dir)
+        reports = self.workflow.monitor_reports(solo.monitors)
+        if reports:
+            entry["monitors"] = reports
+        entry["hyperparams"] = {
+            k: jnp.asarray(v).tolist()
+            for k, v in self.workflow.tenant_hyperparams(
+                index, state=self.state
+            ).items()
+        }
+        slot.active = False
+        self.results.append(entry)
+        self._refill(index)
+        return entry
+
+    def _retire(self, index: int, status: str) -> dict:
+        self.counters["retired"] += 1
+        return self._close_out(index, status)
+
+    def evict(self, index: int) -> dict:
+        """Evict slot ``index`` mid-run (between chunks): its state is
+        extracted as a solo snapshot (checkpointed when a directory is
+        configured — the RESUMABLE artifact), the result is recorded
+        with status ``"evicted"``, and the slot is refilled from the
+        pending queue (or parked). Resume the evicted search with
+        ``workflow.solo_workflow(hyperparams=...).run(...,
+        resume_from=<checkpoint>)``."""
+        slot = self.slots[index]
+        if slot is None or not slot.active:
+            raise ValueError(f"slot {index} has no active tenant to evict")
+        self.counters["evicted"] += 1
+        return self._close_out(index, status="evicted")
+
+    def _refill(self, index: int) -> None:
+        """Admit the next pending spec into a freed slot, or park the
+        slot (it keeps stepping in lockstep; its results are ignored)."""
+        if not self.pending:
+            return
+        spec = self.pending.pop(0)  # validated at submit()
+        wf = self.workflow
+        solo = wf.init_tenant(spec.key(), spec.hyperparams)
+        if wf.algorithm.has_init_ask or wf.algorithm.has_init_tell:
+            # algorithms with a distinct first generation peel it SOLO:
+            # the fleet's steady vmapped step must never dispatch
+            # init_ask/init_tell for one slot only (static shape law).
+            # The peel is the fleet's own jitted single-tenant step with
+            # the bindings as traced operands — one compile serves every
+            # admission (and advances the tenant's own generation to 1)
+            solo = wf._solo_peel(solo)
+        self.state = wf.insert_tenant(self.state, index, solo)
+        self.slots[index] = _Slot(spec=spec)
+        self.counters["admitted"] += 1
+        # restore coherence: the supervisor's newest snapshot must
+        # contain the ADMITTED tenant — its restore rung would otherwise
+        # resurrect a pre-admission fleet (structurally identical, so
+        # the config guard cannot object) and silently attribute the old
+        # tenant's trajectory to this spec
+        ckpt = getattr(self.supervisor, "checkpointer", None)
+        if ckpt is not None:
+            ckpt.save(self.state)
+
+    # -------------------------------------------------------------- report
+    def report(self) -> dict:
+        running = sum(1 for s in self.slots if s is not None and s.active)
+        return {
+            "capacity": self.workflow.n_tenants,
+            "chunk": self.chunk,
+            "counters": dict(self.counters),
+            "pending": len(self.pending),
+            "running": running,
+            "results": [
+                {k: v for k, v in r.items() if k != "monitors"}
+                for r in self.results
+            ],
+        }

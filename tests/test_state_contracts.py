@@ -280,6 +280,71 @@ def test_guarded_wrapper_state_contract():
     _check_state(state, "GuardedAlgorithm[CMAES]")
 
 
+def _fake_fitness(pop, n_objs):
+    """Deterministic jittable fitness for an arbitrary candidate pytree:
+    per-row sum of squares across every float leaf (shape (B,) or
+    (B, n_objs))."""
+    leaves = [
+        jnp.asarray(x, jnp.float32)
+        for x in jax.tree.leaves(pop)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+    ]
+    base = sum(
+        jnp.sum(x.reshape(x.shape[0], -1) ** 2, axis=1) for x in leaves
+    )
+    if n_objs == 1:
+        return base
+    return jnp.stack([base * (j + 1.0) for j in range(n_objs)], axis=1)
+
+
+# algorithms whose ask/tell cannot run under a leading tenant axis; every
+# other registered algorithm must vmap — additions here require a
+# conscious decision (and a note on why), exactly like
+# KNOWN_UNCONSTRUCTIBLE
+KNOWN_UNVMAPPABLE = set()
+
+
+@pytest.mark.parametrize("name", sorted(_constructible()))
+def test_algorithm_vmap_contract(name):
+    """vmap-ability as a state contract (PR 8, workflows/tenancy.py):
+    every registered algorithm must run init -> (init_ask/init_tell ->)
+    ask -> tell with a leading TENANT axis added by ``jax.vmap`` — the
+    mechanical guarantee behind ``VectorizedWorkflow`` fleets. A state
+    or ask/tell that breaks under vmap (host-side control flow on traced
+    values, shape-dependent python branching on per-instance data) is
+    caught here, not when a user stacks the algorithm into a fleet.
+    Structural contract only (each leaf gains exactly the tenant axis
+    and stays finite-typed); trajectory equivalence vs solo runs is
+    asserted per-algorithm in tests/test_tenancy.py, where codegen
+    tolerance is documented."""
+    if name in KNOWN_UNVMAPPABLE:
+        pytest.skip(f"{name} is explicitly excluded from the vmap contract")
+    algo = _constructible()[name]
+    n_objs = int(getattr(algo, "n_objs", 1))
+
+    def run_one(key):
+        s = algo.init(key)
+        if algo.has_init_ask or algo.has_init_tell:
+            pop, s = algo.init_ask(s)
+            s = algo.init_tell(s, _fake_fitness(pop, n_objs))
+        pop, s = algo.ask(s)
+        return algo.tell(s, _fake_fitness(pop, n_objs))
+
+    keys = jax.random.split(jax.random.PRNGKey(7), 2)
+    stacked = jax.jit(jax.vmap(run_one))(keys)
+    solo = run_one(keys[0])
+    stacked_leaves = jax.tree_util.tree_flatten_with_path(stacked)[0]
+    solo_leaves = jax.tree_util.tree_flatten_with_path(solo)[0]
+    assert len(stacked_leaves) == len(solo_leaves)
+    for (path, a), (_, b) in zip(stacked_leaves, solo_leaves):
+        where = f"{name}{jax.tree_util.keystr(path)}"
+        assert a.shape == (2,) + jnp.shape(b), (
+            f"{where}: vmapped leaf shape {a.shape} is not the solo "
+            f"shape {jnp.shape(b)} plus a leading tenant axis"
+        )
+        assert a.dtype == jnp.asarray(b).dtype, f"{where}: dtype changed"
+
+
 def test_monitor_state_contracts():
     """Monitor states: frozen pytree dataclasses, all fields P() (their
     buffers are capacity-leading, not population-leading)."""

@@ -320,8 +320,8 @@ def test_instrument_and_run_report(tmp_path):
     assert fetches["gen"]["calls"] == 1 and fetches["gen"]["bytes"] == 4
 
     report = run_report(wf, state, recorder=rec, extra={"tag": "unit"})
-    # v2: roofline sections carry dtype-policy + donation provenance
-    assert report["schema"] == "evox_tpu.run_report/v2"
+    # v3: v2's roofline provenance plus the optional tenancy section
+    assert report["schema"] == "evox_tpu.run_report/v3"
     assert report["generation"] == 17
     tel = report["telemetry"][0]
     assert tel["monitor"] == "TelemetryMonitor"

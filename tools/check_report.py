@@ -162,6 +162,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                         f"{where}: supervisor has an abort event but "
                         f"outcome {sup.get('outcome')!r}"
                     )
+    tenancy = report.get("tenancy")
+    if tenancy is not None:
+        errors += _validate_tenancy(tenancy, where)
     roofline = report.get("roofline")
     if roofline is not None:
         if not isinstance(roofline, dict):
@@ -259,6 +262,109 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     return errors
 
 
+def _validate_tenancy(tenancy: Any, where: str) -> List[str]:
+    """The ``tenancy`` section (schema v3, workflows/tenancy.py): fleet
+    shape coherent with the state's measured leading axes, per-tenant
+    monitor counters non-negative with monotonic trajectory rings, and
+    sane RunQueue counters when a queue drove the fleet."""
+    errors: List[str] = []
+    if not isinstance(tenancy, dict):
+        return [f"{where}: tenancy is not an object"]
+    if set(tenancy) == {"error"}:
+        # degraded form, same contract as roofline.error
+        if not isinstance(tenancy["error"], str):
+            errors.append(f"{where}: tenancy.error is not a string")
+        return errors
+    n = tenancy.get("n_tenants")
+    if not isinstance(n, int) or n < 1:
+        errors.append(f"{where}: tenancy.n_tenants missing or < 1")
+        return errors
+    leading = tenancy.get("leading_axes")
+    if not isinstance(leading, list) or any(
+        not isinstance(v, int) for v in leading
+    ):
+        errors.append(f"{where}: tenancy.leading_axes missing/non-int")
+    elif leading and leading != [n]:
+        # every tenant-stacked leaf must lead with exactly n_tenants —
+        # anything else means the report and the state disagree about
+        # the fleet width
+        errors.append(
+            f"{where}: tenancy.leading_axes {leading} incoherent with "
+            f"n_tenants={n}"
+        )
+    per_tenant = tenancy.get("per_tenant")
+    if not isinstance(per_tenant, list) or len(per_tenant) != n:
+        errors.append(
+            f"{where}: tenancy.per_tenant missing or length != n_tenants"
+        )
+        return errors
+    for i, entry in enumerate(per_tenant):
+        loc = f"{where}: tenancy.per_tenant[{i}]"
+        if not isinstance(entry, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        if entry.get("tenant") != i:
+            errors.append(
+                f"{loc}.tenant {entry.get('tenant')!r} != index {i}"
+            )
+        for mi, mon in enumerate(entry.get("monitors", []) or []):
+            mloc = f"{loc}.monitors[{mi}]"
+            if not isinstance(mon, dict) or "monitor" not in mon:
+                errors.append(f"{mloc} lacks a 'monitor' key")
+                continue
+            for key in ("generations", "evals"):
+                v = mon.get(key)
+                if v is not None and (not isinstance(v, int) or v < 0):
+                    errors.append(
+                        f"{mloc}.{key} not a non-negative int"
+                    )
+            traj = mon.get("trajectory")
+            if isinstance(traj, dict):
+                gens = traj.get("generation", [])
+                if not isinstance(gens, list):
+                    errors.append(
+                        f"{mloc}.trajectory.generation is not a list"
+                    )
+                elif any(b <= a for a, b in zip(gens, gens[1:])):
+                    errors.append(
+                        f"{mloc}.trajectory.generation not strictly "
+                        "increasing"
+                    )
+    queue = tenancy.get("queue")
+    if queue is not None:
+        if not isinstance(queue, dict):
+            errors.append(f"{where}: tenancy.queue is not an object")
+        else:
+            counters = queue.get("counters")
+            if not isinstance(counters, dict):
+                errors.append(f"{where}: tenancy.queue.counters missing")
+            else:
+                for key in ("submitted", "admitted", "retired", "evicted"):
+                    v = counters.get(key)
+                    if not isinstance(v, int) or v < 0:
+                        errors.append(
+                            f"{where}: tenancy.queue.counters.{key} "
+                            "missing or not a non-negative int"
+                        )
+                if all(
+                    isinstance(counters.get(k), int)
+                    for k in ("submitted", "admitted", "retired", "evicted")
+                ):
+                    if counters["admitted"] > counters["submitted"]:
+                        errors.append(
+                            f"{where}: tenancy.queue admitted > submitted"
+                        )
+                    if (
+                        counters["retired"] + counters["evicted"]
+                        > counters["admitted"]
+                    ):
+                        errors.append(
+                            f"{where}: tenancy.queue retired+evicted > "
+                            "admitted"
+                        )
+    return errors
+
+
 def validate_bench(summary: Any, where: str = "bench") -> List[str]:
     errors: List[str] = []
     if not isinstance(summary, dict):
@@ -285,24 +391,37 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             not isinstance(rounds, list) or not all(_num(r) for r in rounds)
         ):
             errors.append(f"{loc}.ratio_rounds neither null nor numeric list")
-        if "bf16" in str(leg.get("metric", "")).lower():
-            # a bf16 A/B leg without its f32 reference ratio is an
-            # asserted win, not a measured one — reject it
+        metric_l = str(leg.get("metric", "")).lower()
+        # self-baselined A/B legs must carry a MEASURED ratio: a leg
+        # without vs_baseline is an asserted win, and without
+        # ratio_rounds it lacks the spread self-check the differenced
+        # protocol requires
+        for keyword, ratio_name in (
+            ("bf16", "its f32 reference ratio"),
+            ("tenant", "its sequential-baseline ratio"),
+        ):
+            if keyword not in metric_l:
+                continue
             if vs is None or not _num(vs):
                 errors.append(
-                    f"{loc}: bf16 leg is missing its f32 reference ratio "
-                    "(vs_baseline null) — the storage-policy win must be "
-                    "measured, not asserted"
+                    f"{loc}: {keyword} leg is missing {ratio_name} "
+                    "(vs_baseline null) — the win must be measured, "
+                    "not asserted"
                 )
             if rounds is None:
                 errors.append(
-                    f"{loc}: bf16 leg has no ratio_rounds — the A/B "
-                    "spread is the self-check the differenced protocol "
-                    "requires"
+                    f"{loc}: {keyword} leg has no ratio_rounds — the "
+                    "A/B spread is the self-check the differenced "
+                    "protocol requires"
                 )
     rr = summary.get("run_report")
     if rr is not None:
         errors += validate_run_report(rr, where=f"{where}: run_report")
+    ten = summary.get("tenancy")
+    if isinstance(ten, dict) and ten.get("run_report") is not None:
+        errors += validate_run_report(
+            ten["run_report"], where=f"{where}: tenancy.run_report"
+        )
     return errors
 
 
