@@ -18,6 +18,7 @@ from .distributed import (
     process_count,
     is_dist_initialized,
 )
+from .executor import GenerationExecutor
 from .instrument import (
     DispatchRecorder,
     RetraceError,
@@ -47,6 +48,7 @@ __all__ = [
     "GuardedState",
     "IPOPRestarts",
     "recenter_state",
+    "GenerationExecutor",
     "DispatchRecorder",
     "RetraceError",
     "CHIP_CEILINGS",

@@ -477,6 +477,7 @@ def run_report(
     extra: Optional[dict] = None,
     analyzer: Optional[CostAnalyzer] = None,
     supervisor: Any = None,
+    executor: Any = None,
 ) -> dict:
     """Merge device telemetry and host dispatch timings into ONE
     JSON-serializable dict.
@@ -505,9 +506,10 @@ def run_report(
     # v2: roofline sections carry dtype_policy + donation provenance
     # (tools/check_report.py enforces them for v2+, exempting the
     # historical v1 captures). v3 adds the optional `tenancy` section
-    # (multi-tenant fleets, workflows/tenancy.py) — per-tenant monitor
-    # reports + fleet shape, validated when present.
-    report: dict = {"schema": "evox_tpu.run_report/v3"}
+    # (multi-tenant fleets, workflows/tenancy.py). v4 adds the optional
+    # `executor` section (core/executor.py GenerationExecutor: queue
+    # depth, overlap spans, staleness counters) — validated when present.
+    report: dict = {"schema": "evox_tpu.run_report/v4"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -587,6 +589,14 @@ def run_report(
         supervisor = getattr(workflow, "_run_supervisor", None)
     if supervisor is not None and hasattr(supervisor, "report"):
         report["supervisor"] = supervisor.report()
+    # generation executor (core/executor.py): the workflow's most recent
+    # executor-backed run advertises itself as `_run_executor` — queue
+    # depth, overlap spans, and staleness counters become the `executor`
+    # section (duck-typed: anything with a zero-arg report() works)
+    if executor is None and workflow is not None:
+        executor = getattr(workflow, "_run_executor", None)
+    if executor is not None and hasattr(executor, "report"):
+        report["executor"] = executor.report()
     if extra:
         report["extra"] = dict(extra)
     return sanitize_json(report)
@@ -633,6 +643,7 @@ def write_chrome_trace(
     state: Any = None,
     extra_counters: Optional[Dict[str, Sequence[Tuple[float, Any]]]] = None,
     supervisor: Any = None,
+    executor: Any = None,
 ) -> dict:
     """Export a run as Chrome trace-event JSON (open in Perfetto or
     chrome://tracing) and return the trace dict.
@@ -660,6 +671,13 @@ def write_chrome_trace(
       ``supervisor:abort`` — on their own "run supervisor" process at
       their true host timestamps (same ``perf_counter`` clock as the
       recorder).
+    - Executor activity (``executor=`` a :class:`~evox_tpu.core.executor.
+      GenerationExecutor`, or picked up duck-typed from
+      ``workflow._run_executor``) lands on a "generation executor"
+      process: overlap spans (device dispatch / host eval / background
+      checkpoint+fetch I/O, one thread per track) as complete slices at
+      their true host timestamps, plus queue-depth and stale-lag counter
+      tracks.
 
     Entirely host-side (no callbacks, axon-safe): everything exported was
     already recorded outside traced code.
@@ -775,6 +793,39 @@ def write_chrome_trace(
                         "args": sanitize_json(m.get("args", {})),
                     }
                 )
+
+    if executor is None and workflow is not None:
+        executor = getattr(workflow, "_run_executor", None)
+    if executor is not None and hasattr(executor, "trace_spans"):
+        spans = executor.trace_spans()
+        samples = (
+            executor.counter_samples()
+            if hasattr(executor, "counter_samples")
+            else {}
+        )
+        if spans or any(samples.values()):
+            events.append(meta(4, "generation executor"))
+            tids: Dict[str, int] = {}
+            for span in spans:
+                tids.setdefault(span["track"], len(tids) + 1)
+            for track, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+                events.append(meta(4, track, tid))
+            for span in spans:
+                ev = {
+                    "ph": "X",
+                    "name": span["name"],
+                    "cat": "executor",
+                    "pid": 4,
+                    "tid": tids[span["track"]],
+                    "ts": round(max(span["t_abs"] - t0, 0.0) * _US, 3),
+                    "dur": round(max(span["dur"], 0.0) * _US, 3),
+                }
+                if span.get("args"):
+                    ev["args"] = sanitize_json(span["args"])
+                events.append(ev)
+            for track, track_samples in samples.items():
+                rel = [(t - t0, v) for t, v in track_samples]
+                events.extend(_counter_events(track, rel, pid=4))
 
     trace = {
         "traceEvents": events,

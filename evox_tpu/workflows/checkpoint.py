@@ -378,6 +378,35 @@ def resolve_resume(
     return state, max(n_steps - int(state.generation), 0)
 
 
+def enter_run(
+    state: Any,
+    n_steps: int,
+    checkpointer: Optional[WorkflowCheckpointer] = None,
+    resume_from: Any = None,
+    expect_like: Any = None,
+    allow_config_mismatch: bool = False,
+):
+    """The shared run prologue every driver used to hand-roll (std.py,
+    islands.py, pipelined.py, tenancy.py, supervisor.py each repeated
+    the same three steps): resolve ``resume_from`` into (restored state,
+    REMAINING generations), and default the checkpointer to the resumed
+    directory — a resumed run must stay crash-safe and record its own
+    completion, or a second resume would re-run generations. Returns
+    ``(state, remaining_steps, checkpointer)``; a no-op (checkpointer
+    passed through) when ``resume_from`` is None."""
+    if resume_from is not None:
+        state, n_steps = resolve_resume(
+            resume_from,
+            state,
+            n_steps,
+            expect_like=expect_like,
+            allow_config_mismatch=allow_config_mismatch,
+        )
+        if checkpointer is None:
+            checkpointer = _as_checkpointer(resume_from)
+    return state, n_steps, checkpointer
+
+
 def checkpointed_run(wf, state, n_steps: int, checkpointer: WorkflowCheckpointer):
     """``wf.run`` with host-side snapshots between dispatches.
 
@@ -389,12 +418,15 @@ def checkpointed_run(wf, state, n_steps: int, checkpointer: WorkflowCheckpointer
     and a crash between chunks resumes from the last snapshot with
     nothing lost but the current chunk. The final state is always
     snapshotted (even off-cadence) so a completed run restores to its
-    true end."""
-    remaining = n_steps
-    while remaining > 0:
-        chunk = min(remaining, chunk_to_boundary(state, checkpointer))
-        state = wf.run(state, chunk)
-        remaining -= chunk
-        if int(state.generation) % checkpointer.every == 0 or remaining == 0:
-            checkpointer.save(state)
-    return state
+    true end.
+
+    Since the executor port this is a thin policy over
+    :class:`~evox_tpu.core.executor.GenerationExecutor` — the cadence
+    chunking lives there once, and the snapshot pickle+fsync runs on the
+    executor's background checkpoint lane (bounded in-flight, drained
+    before return) instead of stalling the next chunk's dispatch."""
+    from ..core.executor import GenerationExecutor
+
+    return GenerationExecutor().run_fused(
+        wf, state, n_steps, checkpointer=checkpointer
+    )

@@ -194,15 +194,15 @@ class IslandWorkflow:
         cadence, snapshot between dispatches, resume to the TOTAL
         generation target with the config-fingerprint guard armed) — and
         make :class:`~evox_tpu.workflows.supervisor.RunSupervisor`'s
-        restore rung work for island runs too."""
-        from .checkpoint import _as_checkpointer, checkpointed_run, resolve_resume
+        restore rung work for island runs too. The cadence chunking and
+        background snapshot lane live in
+        :class:`~evox_tpu.core.executor.GenerationExecutor` (one
+        executor, five policies)."""
+        from .checkpoint import checkpointed_run, enter_run
 
-        if resume_from is not None:
-            state, n_steps = resolve_resume(
-                resume_from, state, n_steps, expect_like=state
-            )
-            if checkpointer is None:
-                checkpointer = _as_checkpointer(resume_from)
+        state, n_steps, checkpointer = enter_run(
+            state, n_steps, checkpointer, resume_from, expect_like=state
+        )
         if checkpointer is not None:
             return checkpointed_run(self, state, n_steps, checkpointer)
         return fused_run(self, state, n_steps)

@@ -19,17 +19,25 @@ The data-dependency chain eval -> tell -> ask -> eval is untouched, so
 results are bit-identical to ``wf.step`` loops (asserted in
 tests/test_pipelined.py); only wall-clock changes. For jittable problems
 use ``wf.run`` — a fused device loop beats any host pipelining.
+
+Since PR 8 the loop itself lives in
+:class:`~evox_tpu.core.executor.GenerationExecutor` (one executor, five
+policies — see GUIDE.md §6): this module keeps the host-problem policy
+entry point (``run_host_pipelined``), the IPOP recursion, and
+``chunked_evaluate``, and adds the opt-in ``max_staleness=K`` stale-tell
+mode the executor implements.
 """
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from .checkpoint import WorkflowCheckpointer, _as_checkpointer, resolve_resume
+from ..core.executor import GenerationExecutor
+from .checkpoint import WorkflowCheckpointer
 
 
 def chunked_evaluate(problem, pstate, cand, eval_chunk: Optional[int]):
@@ -44,7 +52,16 @@ def chunked_evaluate(problem, pstate, cand, eval_chunk: Optional[int]):
     seed per evaluate() CALL — those re-seed per chunk, see GUIDE.md §6).
     The problem state threads through the chunks in order and the LAST
     chunk's returned state is kept, matching the unchunked call for
-    pass-through states."""
+    pass-through states.
+
+    Return contract: device/dtype-consistent with the unchunked path. A
+    problem returning device arrays gets a device concatenation (the
+    old code forced every chunk to host via ``np.asarray`` and returned
+    NumPy fitness while the unchunked path returned whatever ``evaluate``
+    produced — a silent device→host→device round trip per chunk on the
+    tunnel); a NumPy-returning host problem still gets NumPy. The caller
+    (``pipeline_tell``) accepts either — nothing fetches until someone
+    actually needs host values."""
     if eval_chunk is None:
         return problem.evaluate(pstate, cand)
     leaves = jax.tree.leaves(cand)
@@ -58,8 +75,12 @@ def chunked_evaluate(problem, pstate, cand, eval_chunk: Optional[int]):
         hi = min(lo + eval_chunk, n)
         part = jax.tree.map(lambda x: x[lo:hi], cand)
         fit, pstate = problem.evaluate(pstate, part)
-        fits.append(np.asarray(fit))
-    return np.concatenate(fits, axis=0), pstate
+        fits.append(fit)
+    if any(isinstance(f, jax.Array) for f in fits):
+        # mirror the unchunked path's device residency: concatenate on
+        # device instead of round-tripping every chunk through the host
+        return jnp.concatenate([jnp.asarray(f) for f in fits], axis=0), pstate
+    return np.concatenate([np.asarray(f) for f in fits], axis=0), pstate
 
 
 def run_host_pipelined(
@@ -71,6 +92,8 @@ def run_host_pipelined(
     resume_from: Any = None,
     restarts: Any = None,
     eval_chunk: Optional[int] = None,
+    max_staleness: Optional[int] = None,
+    executor: Optional[GenerationExecutor] = None,
 ):
     """Run ``n_steps`` generations of ``wf`` (a :class:`StdWorkflow` whose
     problem is external/host-side), overlapping host evaluation with
@@ -107,6 +130,21 @@ def run_host_pipelined(
     :class:`~evox_tpu.workflows.supervisor.RunSupervisor` halves on
     OOM / HTTP 413, also usable directly to keep tunneled request sizes
     bounded.
+
+    ``max_staleness=K`` (opt-in; ``None`` — the default — defers to the
+    passed ``executor``'s configured bound, else 0): admit tells up to
+    ``K`` generations stale — up to ``K+1`` host evaluations in flight, each
+    tell grafted onto the newest told state with its own matched
+    (ask-artifacts, fitness) pair (stale-gradient ES; see
+    :class:`~evox_tpu.core.executor.GenerationExecutor`). ``K=0``
+    stays bit-identical to a ``wf.step`` loop; ``K>0`` trades
+    per-update freshness for throughput when host evaluations can run
+    concurrently and is gated by convergence tests, not equivalence.
+
+    ``executor=``: the :class:`~evox_tpu.core.executor.
+    GenerationExecutor` to drive (counters/overlap spans accumulate on
+    it and surface in ``run_report()["executor"]``); a private default
+    executor is created per call otherwise.
     """
     if not wf.external:
         raise ValueError(
@@ -126,76 +164,26 @@ def run_host_pipelined(
             restarts,
             segment=lambda w, s, c, ck: run_host_pipelined(
                 w, s, c, on_generation=on_generation, checkpointer=ck,
-                eval_chunk=eval_chunk,
+                eval_chunk=eval_chunk, max_staleness=max_staleness,
+                executor=executor,
             ),
             checkpointer=checkpointer,
             resume_from=resume_from,
         )
-    if resume_from is not None:
-        # expect_like=state: refuse a snapshot from a different config
-        state, n_steps = resolve_resume(
-            resume_from, state, n_steps, expect_like=state
-        )
-        if checkpointer is None:
-            # a resumed run must stay crash-safe (and must record its own
-            # completion, or a second resume would re-run generations):
-            # default to checkpointing into the directory we resumed from,
-            # the same policy as StdWorkflow.resume()
-            checkpointer = _as_checkpointer(resume_from)
-    if n_steps <= 0:
-        # nothing left to run (e.g. resuming an already-complete run) —
-        # return BEFORE dispatching ask/eval: a stray background evaluate
-        # would waste a full generation and race the caller on the
-        # problem's sockets/state
-        return state
-    # on_generation receives the GLOBAL 0-based generation index (loop
-    # offset + the state's generation at entry), so logs and metric sinks
-    # stay consistent when a run is resumed mid-way instead of restarting
-    # from 0 (identical to the old loop index for fresh states)
-    gen0 = int(state.generation)
-    eval_pool = ThreadPoolExecutor(max_workers=1)
-    hook_pool = ThreadPoolExecutor(max_workers=1)
-    try:
-        cand, ctx = wf.pipeline_ask(state)
-        fut = eval_pool.submit(
-            chunked_evaluate, wf.problem, state.prob, cand, eval_chunk
-        )
-        hook_fut = None
-        for g in range(n_steps):
-            fitness, _ = fut.result()
-            if hook_fut is not None:
-                # surface on_generation errors from generation g-1 BEFORE
-                # advancing the state or submitting generation g+1's eval
-                # (the hook still overlapped generation g's evaluate, which
-                # just completed above — the dominant host-side cost)
-                hook_fut.result()
-                hook_fut = None
-            # discard the problem's returned state, exactly like the
-            # wf.step external path does (common.py callback_evaluate):
-            # host problems keep generation-to-generation state host-side
-            state = wf.pipeline_tell(state, ctx, fitness, state.prob)
-            if g + 1 < n_steps:
-                # async dispatch: returns while the device still computes;
-                # the eval thread blocks on cand materialization, not us
-                cand, ctx = wf.pipeline_ask(state)
-                fut = eval_pool.submit(
-                    chunked_evaluate, wf.problem, state.prob, cand, eval_chunk
-                )
-            if checkpointer is not None:
-                # between dispatches: the next eval is already in flight
-                # and the state is immutable, so the snapshot only costs
-                # the device->host copy at the checkpoint cadence
-                checkpointer.maybe_save(state)
-            if on_generation is not None:
-                hook_fut = hook_pool.submit(
-                    on_generation, gen0 + g, state, fitness
-                )
-        if hook_fut is not None:
-            hook_fut.result()
-        if checkpointer is not None:
-            if int(state.generation) % checkpointer.every != 0:
-                checkpointer.save(state)  # final state is always durable
-        return state
-    finally:
-        eval_pool.shutdown(wait=False)
-        hook_pool.shutdown(wait=False)
+    ex = executor if executor is not None else GenerationExecutor(
+        max_staleness=max_staleness or 0
+    )
+    # the executor owns the loop (double-buffered dispatch, background
+    # checkpoint/hook lanes, resume resolution, stale window); this
+    # function is the host-problem POLICY entry point kept for API
+    # stability and the IPOP recursion above
+    return ex.run_host(
+        wf,
+        state,
+        n_steps,
+        on_generation=on_generation,
+        checkpointer=checkpointer,
+        resume_from=resume_from,
+        eval_chunk=eval_chunk,
+        max_staleness=max_staleness,
+    )

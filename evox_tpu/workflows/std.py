@@ -43,9 +43,8 @@ from ..core.dtype_policy import DtypePolicy, apply_compute, apply_storage
 from ..utils.common import parse_opt_direction
 from .checkpoint import (
     WorkflowCheckpointer,
-    _as_checkpointer,
     checkpointed_run,
-    resolve_resume,
+    enter_run,
 )
 from .common import (
     build_hook_table,
@@ -345,7 +344,12 @@ class StdWorkflow:
         first generation is peeled off eagerly (``first_step`` is static so
         the loop carry stays type-stable across the init_ask/init_tell
         dispatch). With ``jit_step=False`` this falls back to an eager
-        Python loop for debugging.
+        Python loop for debugging. External (host) problems route through
+        the :class:`~evox_tpu.core.executor.GenerationExecutor` host
+        pipeline instead (bit-identical to a ``step`` loop and axon-legal
+        — a ``pure_callback`` inside a fused ``fori_loop`` is not); use
+        :func:`~evox_tpu.workflows.pipelined.run_host_pipelined` directly
+        for ``on_generation``/``eval_chunk``/``max_staleness`` control.
 
         Crash safety (axon-safe, no host callbacks — see
         workflows/checkpoint.py): ``checkpointer=`` chunks the fused loop
@@ -368,6 +372,16 @@ class StdWorkflow:
         — a resumed run rebuilds the snapshot's population size first.
         """
         if restarts is not None:
+            if self.external:
+                # host problems take the executor pipeline for IPOP too —
+                # an ipop segment through fused_run would trace the
+                # pure_callback step the executor routing exists to avoid
+                from .pipelined import run_host_pipelined
+
+                return run_host_pipelined(
+                    self, state, n_steps, checkpointer=checkpointer,
+                    resume_from=resume_from, restarts=restarts,
+                )
             from .ipop import ipop_run
 
             return ipop_run(
@@ -383,17 +397,24 @@ class StdWorkflow:
                 checkpointer=checkpointer,
                 resume_from=resume_from,
             )
-        if resume_from is not None:
-            # expect_like=state arms the checkpoint config-fingerprint
-            # guard: the caller's live state IS the run's config
-            state, n_steps = resolve_resume(
-                resume_from, state, n_steps, expect_like=state
+        # shared prologue (workflows/checkpoint.py enter_run): resolve a
+        # resume into (restored state, REMAINING steps) with the
+        # config-fingerprint guard armed on the caller's live state, and
+        # default the checkpointer to the resumed directory
+        state, n_steps, checkpointer = enter_run(
+            state, n_steps, checkpointer, resume_from, expect_like=state
+        )
+        if self.external:
+            # host-problem path: since PR 8 the fused callback loop is
+            # replaced by the executor's double-buffered host pipeline
+            # (bit-identical to a step loop — the run==step law — and,
+            # unlike a pure_callback fori_loop, legal on the callback-less
+            # axon backend); checkpoint snapshots ride its background lane
+            from .pipelined import run_host_pipelined
+
+            return run_host_pipelined(
+                self, state, n_steps, checkpointer=checkpointer
             )
-            if checkpointer is None:
-                # a resumed run stays crash-safe and records its own
-                # completion (else a second resume would re-run
-                # generations): checkpoint into the resumed directory
-                checkpointer = _as_checkpointer(resume_from)
         if checkpointer is not None:
             return checkpointed_run(self, state, n_steps, checkpointer)
         return fused_run(self, state, n_steps)

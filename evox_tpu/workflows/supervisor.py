@@ -44,6 +44,12 @@ instrument.run_report` surfaces them as a ``supervisor`` section and
 on a dedicated supervisor track. No reference analog (the reference
 assumes every dispatch returns); informed by the fault-domain design of
 the PR-2 process farm.
+
+Since the executor port (PR 8) this module is pure POLICY: the chunk
+loops live in :class:`~evox_tpu.core.executor.GenerationExecutor`, and
+``run``/``run_host_pipelined`` wire the deadline watchdog, the
+classified-retry ladder (:meth:`RunSupervisor.call`), the restore
+replay, and the eval-chunk degradation in as executor hooks.
 """
 
 from __future__ import annotations
@@ -54,7 +60,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from .checkpoint import WorkflowCheckpointer, chunk_to_boundary
+from .checkpoint import WorkflowCheckpointer
 
 __all__ = [
     "DispatchDeadlineError",
@@ -415,6 +421,7 @@ class RunSupervisor:
         n_steps: int,
         chunk: Optional[int] = None,
         resume_from: Any = None,
+        executor: Any = None,
     ) -> Any:
         """Supervised ``wf.run``: the fused device loop is chunked (at the
         checkpointer cadence, else ``chunk`` generations, else one
@@ -430,31 +437,27 @@ class RunSupervisor:
         StdWorkflow` and :class:`~evox_tpu.workflows.islands.
         IslandWorkflow` alike. ``resume_from`` (checkpointer or
         directory) restores the newest intact snapshot first and
-        reinterprets ``n_steps`` as the TOTAL generation target."""
-        state, total_target, ckpt = self._enter(wf, state, n_steps, resume_from)
-        budget = {"used": 0}  # restores are bounded per RUN, not per chunk
-        while int(state.generation) < total_target:
-            remaining = total_target - int(state.generation)
-            step = min(remaining, chunk_to_boundary(state, ckpt, chunk))
-            attempted = state
-            state = self.call(
-                lambda: wf.run(attempted, step),
-                entry="run",
-                restore=self._restorer(ckpt, wf, state),
-                restore_budget=budget,
-            )
-            if (
-                ckpt is not None
-                and int(state.generation) > int(attempted.generation)
-                and (
-                    int(state.generation) % ckpt.every == 0
-                    or int(state.generation) >= total_target
-                )
-            ):
-                # only snapshot forward progress — the restore rung hands
-                # back an OLDER state that is already durable
-                ckpt.save(state)
-        return state
+        reinterprets ``n_steps`` as the TOTAL generation target.
+
+        The chunk loop itself lives in :class:`~evox_tpu.core.executor.
+        GenerationExecutor` (this method is the supervision POLICY:
+        deadline, classifier, ladder — wired in as executor hooks);
+        snapshots land on the executor's background checkpoint lane,
+        drained before any restore replays and before the run returns.
+        Pass ``executor=`` to accumulate counters/spans on a shared
+        instance."""
+        from ..core.executor import GenerationExecutor
+
+        ex = executor if executor is not None else GenerationExecutor()
+        return ex.run_fused(
+            wf,
+            state,
+            n_steps,
+            checkpointer=self.checkpointer,
+            chunk=chunk,
+            resume_from=resume_from,
+            supervisor=self,
+        )
 
     # --------------------------------------------------------- pipelined runs
     def run_host_pipelined(
@@ -465,6 +468,8 @@ class RunSupervisor:
         chunk: Optional[int] = None,
         eval_chunk: Optional[int] = None,
         resume_from: Any = None,
+        executor: Any = None,
+        restarts: Any = None,
         **pipelined_kw: Any,
     ) -> Any:
         """Supervised ``run_host_pipelined`` for external (host)
@@ -474,68 +479,42 @@ class RunSupervisor:
         (``eval_chunk`` halves, floored at ``min_eval_chunk``) and the
         chunk retried from its immutable entry state; see
         ``run_host_pipelined(eval_chunk=...)`` for the bit-equivalence
-        contract (row-independent host evaluate)."""
-        from .pipelined import run_host_pipelined as _pipelined
+        contract (row-independent host evaluate). The double-buffered
+        loop and the degrade cell live in the
+        :class:`~evox_tpu.core.executor.GenerationExecutor`; this method
+        supplies the ladder. ``restarts=`` (an ``IPOPRestarts``) keeps
+        the host-boundary IPOP recipe supervised: the run is chunked at
+        the policy cadence and every pipelined segment dispatches under
+        this supervisor's ladder."""
+        from ..core.executor import GenerationExecutor
 
-        state, total_target, ckpt = self._enter(wf, state, n_steps, resume_from)
-        cell = {"eval_chunk": eval_chunk}  # the degrade rung halves this
+        ex = executor if executor is not None else GenerationExecutor()
+        if restarts is not None:
+            from .ipop import ipop_run
 
-        def degrade() -> bool:
-            cur = cell["eval_chunk"]
-            if cur is None:
-                pop = getattr(wf.algorithm, "pop_size", None)
-                if pop is None:
-                    return False
-                nxt = max(int(pop) // 2, self.min_eval_chunk)
-            elif cur <= self.min_eval_chunk:
-                return False
-            else:
-                nxt = max(cur // 2, self.min_eval_chunk)
-            if nxt == cur:
-                return False
-            cell["eval_chunk"] = nxt
-            return True
-
-        budget = {"used": 0}  # restores are bounded per RUN, not per chunk
-        while int(state.generation) < total_target:
-            remaining = total_target - int(state.generation)
-            step = min(remaining, chunk_to_boundary(state, ckpt, chunk))
-            attempted = state
-            state = self.call(
-                lambda: _pipelined(
-                    wf,
-                    attempted,
-                    step,
-                    checkpointer=ckpt,
-                    eval_chunk=cell["eval_chunk"],
-                    **pipelined_kw,
+            return ipop_run(
+                wf,
+                state,
+                n_steps,
+                restarts,
+                segment=lambda w, s, c, ck: ex.run_host(
+                    w, s, c, checkpointer=ck, chunk=chunk,
+                    eval_chunk=eval_chunk, supervisor=self, **pipelined_kw,
                 ),
-                entry="pipelined",
-                restore=self._restorer(ckpt, wf, state),
-                degrade=degrade,
-                restore_budget=budget,
+                checkpointer=self.checkpointer,
+                resume_from=resume_from,
             )
-        return state
-
-    # ------------------------------------------------------------- internals
-    def _enter(self, wf: Any, state: Any, n_steps: int, resume_from: Any):
-        """Shared run prologue: advertise this supervisor on the workflow
-        (run_report/write_chrome_trace pick it up duck-typed — note the
-        attribute reflects the most RECENT supervised run and persists
-        after it; pass ``supervisor=`` explicitly to a report covering a
-        later, unsupervised run of the same workflow object), resolve a
-        resume, and fix the TOTAL generation target."""
-        wf._run_supervisor = self
-        ckpt = self.checkpointer
-        if resume_from is not None:
-            from .checkpoint import _as_checkpointer, resolve_resume
-
-            state, n_steps = resolve_resume(
-                resume_from, state, n_steps, expect_like=state
-            )
-            if ckpt is None:
-                ckpt = _as_checkpointer(resume_from)
-        return state, n_steps + int(state.generation), ckpt
+        return ex.run_host(
+            wf,
+            state,
+            n_steps,
+            checkpointer=self.checkpointer,
+            chunk=chunk,
+            eval_chunk=eval_chunk,
+            resume_from=resume_from,
+            supervisor=self,
+            **pipelined_kw,
+        )
 
     def _restorer(self, ckpt, wf, expect_like):
         """Restore thunk for the ladder's replay rung. The host-numpy

@@ -83,9 +83,8 @@ from ..core.struct import PyTreeNode, field, static_field
 from ..utils.common import parse_opt_direction
 from .checkpoint import (
     WorkflowCheckpointer,
-    _as_checkpointer,
     checkpointed_run,
-    resolve_resume,
+    enter_run,
 )
 from .common import (
     build_hook_table,
@@ -402,12 +401,9 @@ class VectorizedWorkflow:
         ``fori_loop`` dispatch (see :meth:`StdWorkflow.run` — same
         checkpointer/resume laws, applied to the fleet state; the
         supervisor drives this entry point for chunked healing)."""
-        if resume_from is not None:
-            state, n_steps = resolve_resume(
-                resume_from, state, n_steps, expect_like=state
-            )
-            if checkpointer is None:
-                checkpointer = _as_checkpointer(resume_from)
+        state, n_steps, checkpointer = enter_run(
+            state, n_steps, checkpointer, resume_from, expect_like=state
+        )
         if checkpointer is not None:
             return checkpointed_run(self, state, n_steps, checkpointer)
         return fused_run(self, state, n_steps)
@@ -831,12 +827,23 @@ class RunQueue:
         supervisor: Any = None,
         checkpoint_dir: Optional[str] = None,
         keep: int = 2,
+        executor: Any = None,
     ):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        from ..core.executor import GenerationExecutor
+
         self.workflow = workflow
         self.chunk = chunk
         self.supervisor = supervisor
+        # every serving chunk dispatches through ONE GenerationExecutor
+        # (queue scheduling is a thin policy over it): the supervisor
+        # ladder becomes an executor hook. Eviction/retirement snapshots
+        # stay SYNCHRONOUS on the caller thread — they happen between
+        # chunks and their result is handed out immediately
+        self.executor = (
+            executor if executor is not None else GenerationExecutor()
+        )
         self.checkpoint_dir = (
             Path(checkpoint_dir) if checkpoint_dir is not None else None
         )
@@ -909,10 +916,9 @@ class RunQueue:
 
     def _dispatch(self, n: int) -> None:
         wf = self.workflow
-        if self.supervisor is not None:
-            self.state = self.supervisor.run(wf, self.state, n)
-        else:
-            self.state = wf.run(self.state, n)
+        self.state = self.executor.run_fused(
+            wf, self.state, n, supervisor=self.supervisor
+        )
         self.counters["chunks"] += 1
 
     def _tenant_generations(self):

@@ -210,6 +210,18 @@ def test_precision_and_topk_modules_are_callback_free():
         assert rel not in users, f"{rel} must not use host callbacks"
 
 
+def test_executor_module_is_callback_free():
+    """The generation executor (core/executor.py) is the loop every
+    driver now runs through on the axon backend: double-buffered
+    dispatch, background I/O lanes, and stale-tell grafts are all plain
+    host threads + eager jax around dispatches — a host callback
+    anywhere in it would take down every workflow at once."""
+    users = _scan()
+    rel = "core/executor.py"
+    assert (PKG / rel).exists(), f"{rel} missing"
+    assert rel not in users, f"{rel} must not use host callbacks"
+
+
 def test_supervisor_module_is_callback_free():
     """The PR-5 run supervisor is pure host-side control flow — watchdog
     threads, error classification, backoff sleeps, checkpoint replay —

@@ -165,6 +165,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     tenancy = report.get("tenancy")
     if tenancy is not None:
         errors += _validate_tenancy(tenancy, where)
+    executor = report.get("executor")
+    if executor is not None:
+        errors += _validate_executor(executor, where)
     roofline = report.get("roofline")
     if roofline is not None:
         if not isinstance(roofline, dict):
@@ -259,6 +262,123 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                             "pipeline_tell entries show zero alias bytes — "
                             "the aliasing never reached the compiled program"
                         )
+    return errors
+
+
+EXECUTOR_COUNTERS = (
+    "runs",
+    "chunks",
+    "generations",
+    "asks",
+    "tells",
+    "stale_tells",
+    "max_lag",
+    "bg_checkpoint",
+    "bg_hook",
+    "bg_fetch",
+)
+EXECUTOR_SPANS = ("device_dispatch_s", "host_eval_s", "io_s", "wall_s")
+
+
+def _validate_executor(executor: Any, where: str) -> List[str]:
+    """The ``executor`` section (schema v4, core/executor.py): counters
+    must be coherent non-negative ints (a tell can't be staler than the
+    declared bound, stale tells can't outnumber tells), and the overlap
+    spans must be coherent with each other and with the dispatch
+    recorder's window — device dispatch time is a subset of the
+    executor's wall, which is a subset of the recorder's."""
+    errors: List[str] = []
+    if not isinstance(executor, dict):
+        return [f"{where}: executor is not an object"]
+    k = executor.get("max_staleness")
+    if not isinstance(k, int) or k < 0:
+        errors.append(f"{where}: executor.max_staleness missing or negative")
+    counters = executor.get("counters")
+    if not isinstance(counters, dict):
+        errors.append(f"{where}: executor.counters missing")
+        counters = {}
+    for key in EXECUTOR_COUNTERS:
+        v = counters.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: executor.counters.{key} missing or not a "
+                "non-negative int"
+            )
+    if isinstance(counters.get("stale_tells"), int) and isinstance(
+        counters.get("tells"), int
+    ):
+        if counters["stale_tells"] > counters["tells"]:
+            errors.append(f"{where}: executor stale_tells > tells")
+    if (
+        isinstance(counters.get("max_lag"), int)
+        and isinstance(k, int)
+        and counters["max_lag"] > k
+    ):
+        errors.append(
+            f"{where}: executor max_lag {counters['max_lag']} exceeds "
+            f"max_staleness {k}"
+        )
+    queue = executor.get("queue")
+    if not isinstance(queue, dict):
+        errors.append(f"{where}: executor.queue missing")
+    else:
+        for key in ("io_inflight_limit", "io_inflight_max", "stale_window_max"):
+            v = queue.get(key)
+            if not isinstance(v, int) or v < 0:
+                errors.append(
+                    f"{where}: executor.queue.{key} missing or not a "
+                    "non-negative int"
+                )
+        if (
+            isinstance(queue.get("io_inflight_max"), int)
+            and isinstance(queue.get("io_inflight_limit"), int)
+            and queue["io_inflight_max"] > queue["io_inflight_limit"]
+        ):
+            errors.append(
+                f"{where}: executor.queue io_inflight_max exceeds its limit "
+                "— the in-flight bound was not enforced"
+            )
+    overlap = executor.get("overlap")
+    if not isinstance(overlap, dict):
+        errors.append(f"{where}: executor.overlap missing")
+        return errors
+    for key in EXECUTOR_SPANS:
+        v = overlap.get(key)
+        if not _num(v) or v < 0:
+            errors.append(
+                f"{where}: executor.overlap.{key} missing or negative"
+            )
+    wall = overlap.get("wall_s")
+    device = overlap.get("device_dispatch_s")
+    if _num(wall) and _num(device) and device > wall * 1.05 + 1e-3:
+        # device dispatch happens INSIDE executor runs: its total can
+        # never exceed the executor's wall window (host eval legitimately
+        # can — K>0 runs evaluations concurrently)
+        errors.append(
+            f"{where}: executor.overlap.device_dispatch_s {device} exceeds "
+            f"wall_s {wall} — overlap spans incoherent"
+        )
+    eff = overlap.get("overlap_efficiency")
+    if eff is not None:
+        if not _num(eff) or eff <= 0:
+            errors.append(
+                f"{where}: executor.overlap.overlap_efficiency neither null "
+                "nor positive"
+            )
+        elif _num(wall) and _num(device) and _num(overlap.get("host_eval_s")):
+            bound = max(device, overlap["host_eval_s"])
+            if bound > 1e-9 and abs(eff - wall / bound) > max(
+                0.15 * eff, 0.01
+            ):
+                errors.append(
+                    f"{where}: executor.overlap.overlap_efficiency {eff} "
+                    "inconsistent with wall / max(device, host)"
+                )
+    # NOTE: no executor-wall vs recorder-wall cross-check — a
+    # GenerationExecutor documents accumulation across runs, so its wall
+    # window may legitimately predate (and exceed) a recorder attached
+    # later; span coherence is enforced WITHIN the executor section
+    # (device <= wall, efficiency == wall / max(device, host)) instead.
     return errors
 
 
@@ -399,6 +519,7 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
         for keyword, ratio_name in (
             ("bf16", "its f32 reference ratio"),
             ("tenant", "its sequential-baseline ratio"),
+            ("overlap", "its sequential-loop ratio"),
         ):
             if keyword not in metric_l:
                 continue
@@ -422,6 +543,18 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
         errors += validate_run_report(
             ten["run_report"], where=f"{where}: tenancy.run_report"
         )
+    ex = summary.get("executor")
+    if isinstance(ex, dict):
+        if ex.get("run_report") is not None:
+            errors += validate_run_report(
+                ex["run_report"], where=f"{where}: executor.run_report"
+            )
+        eff = ex.get("overlap_efficiency")
+        if eff is not None and (not _num(eff) or eff <= 0):
+            errors.append(
+                f"{where}: executor.overlap_efficiency neither null nor "
+                "positive"
+            )
     return errors
 
 
