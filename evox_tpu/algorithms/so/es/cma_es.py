@@ -33,7 +33,17 @@ from ....core.algorithm import Algorithm
 from jax.sharding import PartitionSpec as P
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
-from .common import clamp_step_size, safe_eigh
+# recombination_weights aliased: CMAES.__init__ has a parameter of that name
+from .common import (
+    bounded_sigma_step,
+    capped_mu_weights,
+    check_dense_scale,
+    clamp_step_size,
+    recombination_weights as _stable_weights,
+    safe_eigh,
+    sorted_selection_moments,
+    weights_at_ranks,
+)
 
 
 def _default_pop_size(dim: int) -> int:
@@ -65,6 +75,8 @@ class CMAES(Algorithm):
         sigma_floor: float = 1e-20,
         sigma_ceiling: float = 1e20,
         cond_cap: float = 1e14,
+        eigh_max_dim: Optional[int] = 4096,
+        dense_budget_elems: Optional[int] = 2**26,
     ):
         assert init_stdev > 0
         # numeric guards (es/common.py): identity for healthy trajectories,
@@ -73,17 +85,27 @@ class CMAES(Algorithm):
         self.sigma_floor = sigma_floor
         self.sigma_ceiling = sigma_ceiling
         self.cond_cap = cond_cap
+        self.eigh_max_dim = eigh_max_dim
         self.center_init = jnp.asarray(center_init, dtype=jnp.float32)
         self.dim = int(self.center_init.shape[0])
         self.init_stdev = float(init_stdev)
         self.pop_size = pop_size or _default_pop_size(self.dim)
+        # scale guard (es/common.py): the dense track stalls/OOMs past the
+        # single-device wall — refuse eagerly with the sep/low-rank handoff
+        # named in the error instead of compiling a program that never ends
+        check_dense_scale(
+            self.dim, self.pop_size, eigh_max_dim, dense_budget_elems, "CMAES"
+        )
         self.cm = cm
         n, lam = self.dim, self.pop_size
 
         if recombination_weights is None:
             mu = lam // 2
-            w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
-            w = w / jnp.sum(w)
+            # f32-stable log-rank weights (es/common.py): log1p raw form +
+            # logsumexp normalization, identical to the classic
+            # log((lam+1)/2) - log(rank) form up to fp rounding at small mu
+            # and correct (no underflow-to-0 tails) at mu ~ 1e6
+            w = _stable_weights(mu, (lam + 1) / 2)
         else:
             w = jnp.asarray(recombination_weights, dtype=jnp.float32)
             mu = int(w.shape[0])
@@ -172,7 +194,7 @@ class CMAES(Algorithm):
         )
 
     def _decompose(self, C: jax.Array):
-        return safe_eigh(C, self.cond_cap)
+        return safe_eigh(C, self.cond_cap, max_dim=self.eigh_max_dim)
 
 
 class SepCMAESState(PyTreeNode):
@@ -188,13 +210,25 @@ class SepCMAESState(PyTreeNode):
 
 class SepCMAES(Algorithm):
     """Separable (diagonal-covariance) CMA-ES — O(d) memory, for very high
-    dimension (Ros & Hansen 2008). Reference cma_es.py:200-253."""
+    dimension (Ros & Hansen 2008). Reference cma_es.py:200-253.
+
+    Low-memory sharded track (PR 10): ``tell`` is expressed through
+    weighted per-candidate moments (``pop_moments``/``tell_with_moments``)
+    so :class:`~evox_tpu.core.distributed.ShardedES` can run the rank-µ
+    and path updates as psum-of-partial-sums over a POP-sharded sample
+    matrix — no device ever gathers the full ``(pop, dim)`` population.
+    The replicated path uses the identical decomposition (sorted-selection
+    moments), so the two differ only by floating-point summation order."""
+
+    pop_shard_capable = True  # ShardedES protocol (core/distributed.py)
+    sharded_pop_fields = ("z",)
 
     def __init__(
         self,
         center_init,
         init_stdev: float,
         pop_size: Optional[int] = None,
+        mu: Optional[int] = None,
         sigma_floor: float = 1e-20,
         sigma_ceiling: float = 1e20,
     ):
@@ -206,17 +240,29 @@ class SepCMAES(Algorithm):
         self.init_stdev = float(init_stdev)
         self.pop_size = pop_size or _default_pop_size(self.dim)
         n, lam = self.dim, self.pop_size
-        mu = lam // 2
-        w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
-        w = w / jnp.sum(w)
+        # mu: optional large-population parent cap (es/common.py
+        # capped_mu_weights — restores mueff = O(mu) at pop ~ 1e5-1e6)
+        mu, w = capped_mu_weights(lam, mu)
         self.mu, self.weights = mu, w
         me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
         self.mueff = me
         self.cc = (4 + me / n) / (n + 4 + 2 * me / n)
         self.cs = (me + 2) / (n + me + 5)
         # separable variant: covariance learning rate scaled up by (n+2)/3
-        self.ccov = (n + 2) / 3 * min(
-            1.0, 2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me) + 2 / ((n + 1.3) ** 2 + me)
+        # (Ros & Hansen 2008) — additionally capped at 1.0: past
+        # mueff ~ (n+2)^2 the scaled rate exceeds 1, turning the
+        # (1 - c1 - cmu) decay factor NEGATIVE and collapsing C to its
+        # floor within generations (observed at pop=1e6). At total rate 1
+        # the covariance is fully re-estimated from the current
+        # generation's mu ~ 5e5 samples — statistically sound at that
+        # sample count, and the cap is inactive at conventional λ.
+        self.ccov = min(
+            1.0,
+            (n + 2) / 3 * min(
+                1.0,
+                2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me)
+                + 2 / ((n + 1.3) ** 2 + me),
+            ),
         )
         self.c1 = self.ccov * 2 / ((n + 1.3) ** 2 + me) / (
             2 / ((n + 1.3) ** 2 + me) + min(1.0, 2 * (me - 2 + 1 / me) / ((n + 2) ** 2 + me))
@@ -244,16 +290,40 @@ class SepCMAES(Algorithm):
         pop = state.mean + state.sigma * jnp.sqrt(state.C) * z
         return pop, state.replace(z=z, key=key)
 
-    def tell(self, state: SepCMAESState, fitness: jax.Array) -> SepCMAESState:
+    # ----------------------------------------- sharded low-memory protocol
+    # (core/distributed.py ShardedES). `ask_rows` is the per-shard sampling
+    # law — each device draws only its own (pop/n_shards, dim) block from a
+    # fold_in-derived stream; `pop_moments` + `tell_with_moments` split the
+    # update at the reduction boundary so the sharded path psums (dim,)
+    # partial sums instead of gathering the population.
+
+    def ask_rows(self, state: SepCMAESState, key: jax.Array, n_rows: int):
+        z = jax.random.normal(key, (n_rows, self.dim))
+        pop = state.mean + state.sigma * jnp.sqrt(state.C) * z
+        return pop, {"z": z}
+
+    def rank_weights(self, ranks: jax.Array) -> jax.Array:
+        return weights_at_ranks(self.weights, ranks, self.mu)
+
+    def pop_moments(self, rows, weights: jax.Array):
+        z = rows["z"]
+        return {"zw": weights @ z, "zzw": weights @ (z**2)}
+
+    def tell_with_moments(
+        self, state: SepCMAESState, moments, fitness: jax.Array
+    ) -> SepCMAESState:
         n = self.dim
-        order = jnp.argsort(fitness)
-        z_sorted = state.z[order][: self.mu]
+        z_w = moments["zw"]
         D = jnp.sqrt(state.C)
-        y_sorted = z_sorted * D
-        y_w = self.weights @ y_sorted
-        z_w = self.weights @ z_sorted
+        # y = z * D rowwise, so the weighted sums factor: y_w = z_w * D and
+        # sum_i w_i y_i^2 = zzw * C — the (dim,)-sized moments are all the
+        # population information the update needs
+        y_w = z_w * D
+        rank_mu = moments["zzw"] * state.C
         mean = state.mean + state.sigma * y_w
-        ps = (1 - self.cs) * state.ps + math.sqrt(self.cs * (2 - self.cs) * self.mueff) * z_w
+        ps = (1 - self.cs) * state.ps + math.sqrt(
+            self.cs * (2 - self.cs) * self.mueff
+        ) * z_w
         it = state.iteration + 1
         ps_norm = jnp.linalg.norm(ps)
         hsig = ps_norm / jnp.sqrt(1 - (1 - self.cs) ** (2 * it.astype(jnp.float32))) < (
@@ -263,19 +333,25 @@ class SepCMAES(Algorithm):
         pc = (1 - self.cc) * state.pc + hsig * math.sqrt(
             self.cc * (2 - self.cc) * self.mueff
         ) * y_w
-        rank_mu = self.weights @ (y_sorted**2)
         C = (
             (1 - self.c1 - self.cmu) * state.C
             + self.c1 * (pc**2 + (1 - hsig) * self.cc * (2 - self.cc) * state.C)
             + self.cmu * rank_mu
         )
         C = jnp.maximum(C, 1e-20)
-        sigma = clamp_step_size(
-            state.sigma * jnp.exp(self.cs / self.damps * (ps_norm / self.chiN - 1)),
+        # bounded CSA step (es/common.py): at mueff ~ 1e5 the raw exponent
+        # is O(sqrt(mueff)) on any slope — identity at conventional λ
+        sigma = bounded_sigma_step(
+            state.sigma,
+            self.cs / self.damps * (ps_norm / self.chiN - 1),
             self.sigma_floor,
             self.sigma_ceiling,
         )
         return state.replace(mean=mean, sigma=sigma, pc=pc, ps=ps, C=C, iteration=it)
+
+    def tell(self, state: SepCMAESState, fitness: jax.Array) -> SepCMAESState:
+        moments, _ = sorted_selection_moments(self, state, fitness)
+        return self.tell_with_moments(state, moments, fitness)
 
 
 class _RestartCMAES(CMAES):

@@ -2,12 +2,180 @@
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
 from ....utils.optimizers import make_optimizer  # re-exported for ES modules
 
-__all__ = ["make_optimizer", "clamp_step_size", "safe_eigh"]
+__all__ = [
+    "make_optimizer",
+    "clamp_step_size",
+    "bounded_sigma_step",
+    "safe_eigh",
+    "EighScaleError",
+    "check_dense_scale",
+    "recombination_weights",
+    "capped_mu_weights",
+    "sorted_selection_moments",
+    "weights_at_ranks",
+]
+
+# largest per-generation |Δ log sigma| the large-pop-safe update allows:
+# ln 2, i.e. sigma at most doubles/halves per generation. Healthy runs at
+# conventional population sizes keep the CSA/PSR exponent well inside
+# ±0.1, so the clamp is the identity there; at pop ~ 1e5-1e6 the
+# selection-biased path length makes the raw exponent O(sqrt(mueff))
+# (hundreds), which un-clamped overflows sigma to the rails in a handful
+# of generations (observed: LMMAES mean -> inf at pop=1e5 on Sphere).
+MAX_LOG_SIGMA_STEP = 0.6931471805599453
+
+
+def bounded_sigma_step(
+    sigma: jax.Array,
+    log_step: jax.Array,
+    floor: float = 1e-20,
+    ceiling: float = 1e20,
+    max_log_step: float = MAX_LOG_SIGMA_STEP,
+) -> jax.Array:
+    """``sigma * exp(log_step)`` with the per-generation log-step clamped
+    into ``[-max_log_step, +max_log_step]`` and the result railed by
+    :func:`clamp_step_size` — the large-population-safe step-size update
+    of the low-memory CMA track. Identity to the classic update whenever
+    ``|log_step| <= max_log_step`` (every healthy conventional-λ run)."""
+    step = jnp.clip(log_step, -max_log_step, max_log_step)
+    return clamp_step_size(sigma * jnp.exp(step), floor, ceiling)
+
+
+class EighScaleError(RuntimeError):
+    """A full-covariance CMA variant was asked for a ``dim``/``pop`` past the
+    single-device dense wall (an O(dim^3) ``eigh`` or an O(pop*dim) candidate
+    matrix that cannot reasonably live on one device). Raised EAGERLY at
+    construction/trace time — the alternative is a silent multi-minute stall
+    (or OOM) inside the compiled program. The message names the way out: the
+    sharded low-memory track (SepCMAES / LMMAES / RMES under
+    :class:`~evox_tpu.core.distributed.ShardedES`), reachable automatically
+    from IPOP via ``IPOPRestarts(handoff_pop=..., handoff_factory=...)``."""
+
+
+def check_dense_scale(
+    dim: int,
+    pop_size: int,
+    eigh_max_dim: Optional[int],
+    dense_budget_elems: Optional[int],
+    where: str = "CMAES",
+) -> None:
+    """Guard the dense (full-covariance) CMA track against silent scaling
+    walls. Both limits are configurable per algorithm and ``None`` disables
+    the corresponding check."""
+    if eigh_max_dim is not None and dim > eigh_max_dim:
+        raise EighScaleError(
+            f"{where}: dim={dim} exceeds eigh_max_dim={eigh_max_dim} — the "
+            "O(dim^3) eigendecomposition of the full covariance would stall "
+            "a single device. Use the low-memory track instead (SepCMAES "
+            "for diagonal, LMMAES/RMES for low-rank covariance), optionally "
+            "POP-sharded via core.distributed.ShardedES; raise eigh_max_dim "
+            "explicitly if you really want the dense eigh at this dim."
+        )
+    if dense_budget_elems is not None and pop_size * dim > dense_budget_elems:
+        raise EighScaleError(
+            f"{where}: pop_size*dim = {pop_size}*{dim} = {pop_size * dim} "
+            f"elements exceeds dense_budget_elems={dense_budget_elems} — the "
+            "dense track materializes the full (pop, dim) sample matrix "
+            "(plus sorted copies) on every device. Hand off to the sharded "
+            "low-memory track: SepCMAES/LMMAES/RMES wrapped in "
+            "core.distributed.ShardedES keep only (pop/n_dev, dim) per "
+            "device; IPOPRestarts(handoff_pop=..., handoff_factory=...) "
+            "performs this handoff automatically when doubling crosses the "
+            "threshold. Raise dense_budget_elems to override."
+        )
+
+
+def recombination_weights(mu: int, mu_half: Optional[float] = None) -> jax.Array:
+    """The CMA-family log-rank recombination weights, f32-stable up to
+    µ ≈ 10^6: ``w_r ∝ log(mu_half) - log(r)`` for ranks r = 1..µ,
+    normalized to sum to 1.
+
+    The naive spelling ``log(mu_half) - log(r)`` cancels catastrophically
+    in f32 for large µ (both terms ≈ 13.8 at µ = 5*10^5 while their
+    difference is ~1e-6 — below f32's absolute resolution at that
+    magnitude, so tail weights collapse to 0 or negative). Two fixes,
+    both f64-free:
+
+    - each raw weight is computed as ``log1p((mu_half - r) / r)`` —
+      algebraically ``log(mu_half / r)`` with full relative precision
+      down to the last rank;
+    - normalization goes through a max-subtracted ``logsumexp`` over the
+      raw weights' logs (``w = exp(log w_r - logsumexp(log w))``) instead
+      of a naive f32 sum, preserving the Σw = 1 invariant at µ = 10^6
+      (asserted at pop ∈ {1e4, 1e6} in tests/test_large_pop.py).
+
+    ``mu_half`` defaults to ``mu + 0.5``; the classic CMA-ES prefactor is
+    ``(lambda + 1) / 2`` (identical for even λ)."""
+    if mu < 1:
+        raise ValueError(f"mu must be >= 1, got {mu}")
+    half = float(mu + 0.5) if mu_half is None else float(mu_half)
+    if half <= mu:
+        raise ValueError(f"mu_half ({half}) must exceed mu ({mu})")
+    r = jnp.arange(1, mu + 1, dtype=jnp.float32)
+    raw = jnp.log1p((half - r) / r)  # log(mu_half / r), stable near r ~ mu_half
+    lw = jnp.log(raw)  # raw > 0 for every r <= mu since mu_half > mu
+    return jnp.exp(lw - jax.nn.logsumexp(lw))
+
+
+def capped_mu_weights(lam: int, mu: Optional[int] = None, mu_half_prefactor: bool = False):
+    """Resolve a CMA-family parent count and its stable log-rank weights.
+
+    ``mu=None`` is the classic untruncated half (``lam // 2``). An
+    explicit ``mu`` below that is the LARGE-POPULATION parent cap (see
+    the GUIDE.md §6 large-pop recipe): strong truncation keeps mueff at
+    O(mu) instead of O(lam), the regime the CSA/PSR constants were
+    derived for — capped weights use the ``mu + 0.5`` prefactor (the
+    ``(lam+1)/2`` one is only meaningful for the untruncated half).
+    ``mu_half_prefactor=True`` forces ``mu + 0.5`` regardless (RMES, per
+    Li & Zhang 2018). Returns ``(mu, weights)``. An explicit ``mu``
+    outside ``[1, lam // 2]`` raises — the truncation-selection weights
+    (and the sharded rank-weight table) assume at most the better half,
+    and silently clamping would hand back a configuration the caller
+    never asked for."""
+    if mu is not None and not (1 <= mu <= lam // 2):
+        raise ValueError(
+            f"mu must be in [1, lam // 2 = {lam // 2}] (got {mu}); the "
+            "log-rank truncation weights select from the better half at "
+            "most"
+        )
+    capped = mu is not None and mu < lam // 2
+    mu = mu if mu is not None else lam // 2
+    half = (mu + 0.5) if (capped or mu_half_prefactor) else (lam + 1) / 2
+    return mu, recombination_weights(mu, half)
+
+
+def sorted_selection_moments(algo, state, fitness: jax.Array):
+    """The REPLICATED tell's moment computation, shared by the low-memory
+    track: stable-sort the fitness, select the top-µ rows of every
+    ``sharded_pop_fields`` artifact, and weight them through the
+    algorithm's ``pop_moments`` — the sorted-selection twin of the
+    rank-weighted psum path (core/distributed.py ``sharded_es_tell``).
+    Returns ``(moments, order)`` so callers can reuse the sort."""
+    order = jnp.argsort(fitness)
+    rows = {
+        name: getattr(state, name)[order][: algo.mu]
+        for name in algo.sharded_pop_fields
+    }
+    return algo.pop_moments(rows, algo.weights), order
+
+
+def weights_at_ranks(weights: jax.Array, ranks: jax.Array, mu: int) -> jax.Array:
+    """Per-candidate recombination weight from its GLOBAL fitness rank
+    (0-based): ``weights[rank]`` for the top-µ, 0 beyond — the gather-free
+    reformulation of "sort, select µ, dot with weights" used by the
+    POP-sharded tell (core/distributed.py ``sharded_es_tell``). The table
+    lookup is bitwise-identical to the sorted-selection weights, so the
+    sharded and replicated paths differ only by summation order."""
+    w = jnp.asarray(weights)
+    safe = jnp.clip(ranks, 0, mu - 1)
+    return jnp.where(ranks < mu, w[safe], jnp.zeros((), dtype=w.dtype))
 
 
 def clamp_step_size(
@@ -25,9 +193,14 @@ def clamp_step_size(
     return jnp.clip(sigma, floor, ceiling)
 
 
-def safe_eigh(C: jax.Array, cond_cap: float = 1e14):
+def safe_eigh(C: jax.Array, cond_cap: float = 1e14, max_dim: Optional[int] = None):
     """``eigh`` of a covariance with condition-number capping and a
     non-finite fallback.
+
+    ``max_dim``: an optional scale guard — a matrix wider than this raises
+    :class:`EighScaleError` at trace/call time (shapes are static, so the
+    check costs nothing on device) instead of silently stalling in an
+    O(dim^3) decomposition; the error names the sep/low-rank handoff.
 
     Returns ``(B, D)`` with ``B`` the eigenvector matrix and ``D`` the
     per-axis standard deviations (sqrt of the clamped eigenvalues):
@@ -46,6 +219,14 @@ def safe_eigh(C: jax.Array, cond_cap: float = 1e14):
       state-level guard (core/guardrail.py) triggers the real recovery.
     """
     n = C.shape[0]
+    if max_dim is not None and n > max_dim:
+        raise EighScaleError(
+            f"safe_eigh: covariance is {n}x{n}, past max_dim={max_dim} — "
+            "the O(dim^3) eigh would stall a single device. Switch to the "
+            "low-memory track (SepCMAES diagonal / LMMAES / RMES low-rank, "
+            "optionally POP-sharded via core.distributed.ShardedES) or "
+            "raise max_dim explicitly."
+        )
     C = (C + C.T) / 2.0
     eigvals, B = jnp.linalg.eigh(C)
     max_eig = jnp.maximum(jnp.max(eigvals), 1e-20)

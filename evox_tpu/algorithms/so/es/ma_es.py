@@ -21,7 +21,14 @@ from ....core.algorithm import Algorithm
 from jax.sharding import PartitionSpec as P
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
-from .common import clamp_step_size
+from .common import (
+    bounded_sigma_step,
+    capped_mu_weights,
+    clamp_step_size,
+    recombination_weights,
+    sorted_selection_moments,
+    weights_at_ranks,
+)
 from .cma_es import _default_pop_size
 
 
@@ -50,8 +57,8 @@ class MAES(Algorithm):
         self.init_stdev = float(init_stdev)
         self.pop_size = lam = pop_size or _default_pop_size(n)
         mu = lam // 2
-        w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
-        w = w / jnp.sum(w)
+        # f32-stable log-rank weights (es/common.py recombination_weights)
+        w = recombination_weights(mu, (lam + 1) / 2)
         self.mu, self.weights = mu, w
         me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
         self.mueff = me
@@ -114,12 +121,25 @@ class LMMAESState(PyTreeNode):
 
 
 class LMMAES(Algorithm):
+    """Limited-memory MA-ES — m = O(log d) direction vectors, O(d log d)
+    memory/compute (Loshchilov, Glasmachers & Beyer 2017).
+
+    Low-memory sharded track (PR 10): because the transform
+    ``d = prod_j ((1-cd_j) I + cd_j m_j m_j^T) z`` is LINEAR per row,
+    ``weights @ transform(z_sel) == transform(weights @ z_sel)`` — so the
+    whole tell needs only the single (dim,) moment ``z_w``, psum-reducible
+    over a POP-sharded sample matrix (``ShardedES``)."""
+
+    pop_shard_capable = True  # ShardedES protocol (core/distributed.py)
+    sharded_pop_fields = ("z",)
+
     def __init__(
         self,
         center_init,
         init_stdev: float,
         pop_size: Optional[int] = None,
         memory_size: Optional[int] = None,
+        mu: Optional[int] = None,
         sigma_floor: float = 1e-20,
         sigma_ceiling: float = 1e20,
     ):
@@ -130,9 +150,9 @@ class LMMAES(Algorithm):
         self.init_stdev = float(init_stdev)
         self.pop_size = lam = pop_size or _default_pop_size(n)
         self.m = memory_size or max(1, 4 + int(3 * math.log(n)))
-        mu = lam // 2
-        w = math.log((lam + 1) / 2) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
-        w = w / jnp.sum(w)
+        # optional large-population parent cap (es/common.py
+        # capped_mu_weights — see the GUIDE.md §6 large-pop recipe)
+        mu, w = capped_mu_weights(lam, mu)
         self.mu, self.weights = mu, w
         me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
         self.mueff = me
@@ -174,24 +194,55 @@ class LMMAES(Algorithm):
         pop = state.mean + state.sigma * d
         return pop, state.replace(z=z, key=key)
 
-    def tell(self, state: LMMAESState, fitness: jax.Array) -> LMMAESState:
-        order = jnp.argsort(fitness)
-        z_sel = state.z[order][: self.mu]
-        z_w = self.weights @ z_sel
-        d_sel = self._transform(z_sel, state.M, state.iteration)
-        d_w = self.weights @ d_sel
+    # ----------------------------------------- sharded low-memory protocol
+    def ask_rows(self, state: LMMAESState, key: jax.Array, n_rows: int):
+        z = jax.random.normal(key, (n_rows, self.dim))
+        d = self._transform(z, state.M, state.iteration)
+        return state.mean + state.sigma * d, {"z": z}
+
+    def rank_weights(self, ranks: jax.Array) -> jax.Array:
+        return weights_at_ranks(self.weights, ranks, self.mu)
+
+    def pop_moments(self, rows, weights: jax.Array):
+        return {"zw": weights @ rows["z"]}
+
+    def tell_with_moments(
+        self, state: LMMAESState, moments, fitness: jax.Array
+    ) -> LMMAESState:
+        z_w = moments["zw"]
+        # the transform is linear per row: transform(weights @ z_sel) ==
+        # weights @ transform(z_sel) — one (1, dim) transform replaces the
+        # (mu, dim) one
+        d_w = self._transform(z_w[None, :], state.M, state.iteration)[0]
         mean = state.mean + state.sigma * d_w
-        csn = self.cs / (self.cs + 2.0) if isinstance(self.cs, float) else self.cs
         cs = min(self.cs, 0.999)
-        ps = (1 - cs) * state.ps + math.sqrt(cs * (2 - cs) * self.mueff) * z_w
+        # path drive v = sqrt(mueff) z_w, NORM-RAILED at 2*chiN: under
+        # neutral selection |v| ~ chiN so the rail is the identity at
+        # conventional λ, but at pop ~ 1e5-1e6 the selection bias makes
+        # |v| = O(sqrt(mueff)) — unrailed, the M rows grow ~ |v|, the
+        # transform gain compounds ~ (cd |m|^2)^m and the mean overflows
+        # within a few generations (observed at pop=1e5 on Sphere). The
+        # rail keeps the DIRECTION and caps the claimed path length.
+        v = jnp.sqrt(jnp.asarray(self.mueff, jnp.float32)) * z_w
+        v = v * jnp.minimum(
+            1.0, 2.0 * self.chiN / jnp.maximum(jnp.linalg.norm(v), 1e-20)
+        )
+        ps = (1 - cs) * state.ps + math.sqrt(cs * (2 - cs)) * v
         M = (1 - self.cc[:, None]) * state.M + jnp.sqrt(
-            self.mueff * self.cc * (2 - self.cc)
-        )[:, None] * z_w[None, :]
-        sigma = clamp_step_size(
-            state.sigma * jnp.exp((cs / 2.0) * (jnp.sum(ps**2) / self.dim - 1.0)),
+            self.cc * (2 - self.cc)
+        )[:, None] * v[None, :]
+        # bounded step (es/common.py): the selection-biased |ps|^2 term is
+        # O(mueff) at very large populations — identity at conventional λ
+        sigma = bounded_sigma_step(
+            state.sigma,
+            (cs / 2.0) * (jnp.sum(ps**2) / self.dim - 1.0),
             self.sigma_floor,
             self.sigma_ceiling,
         )
         return state.replace(
             mean=mean, sigma=sigma, ps=ps, M=M, iteration=state.iteration + 1
         )
+
+    def tell(self, state: LMMAESState, fitness: jax.Array) -> LMMAESState:
+        moments, _ = sorted_selection_moments(self, state, fitness)
+        return self.tell_with_moments(state, moments, fitness)

@@ -19,7 +19,12 @@ from ....core.algorithm import Algorithm
 from jax.sharding import PartitionSpec as _PS
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
-from .common import clamp_step_size
+from .common import (
+    capped_mu_weights,
+    clamp_step_size,
+    sorted_selection_moments,
+    weights_at_ranks,
+)
 from .cma_es import _default_pop_size
 
 
@@ -37,12 +42,24 @@ class RMESState(PyTreeNode):
 
 
 class RMES(Algorithm):
+    """Rank-m ES — low-rank covariance model from m stored evolution paths.
+
+    Low-memory sharded track (PR 10): ``state.z`` stores the COMPOSED
+    per-candidate directions y (see ``ask``), so the whole tell reduces to
+    the single (dim,) moment ``y_w = Σ w_i y_i`` plus fitness-sized PSR
+    bookkeeping — psum-reducible over a POP-sharded sample matrix
+    (``ShardedES``)."""
+
+    pop_shard_capable = True  # ShardedES protocol (core/distributed.py)
+    sharded_pop_fields = ("z",)
+
     def __init__(
         self,
         center_init,
         init_stdev: float,
         pop_size: Optional[int] = None,
         memory_size: int = 2,
+        mu: Optional[int] = None,
         sigma_floor: float = 1e-20,
         sigma_ceiling: float = 1e20,
     ):
@@ -53,9 +70,9 @@ class RMES(Algorithm):
         self.init_stdev = float(init_stdev)
         self.pop_size = lam = pop_size or _default_pop_size(n)
         self.m = memory_size
-        mu = lam // 2
-        w = math.log(mu + 0.5) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
-        w = w / jnp.sum(w)
+        # optional large-population parent cap; RMES always uses the
+        # mu+0.5 prefactor per Li & Zhang 2018 (es/common.py)
+        mu, w = capped_mu_weights(lam, mu, mu_half_prefactor=True)
         self.mu, self.weights = mu, w
         me = float(jnp.sum(w) ** 2 / jnp.sum(w**2))
         self.mueff = me
@@ -81,23 +98,49 @@ class RMES(Algorithm):
             key=key,
         )
 
-    def ask(self, state: RMESState) -> Tuple[jax.Array, RMESState]:
-        key, kz, kr = jax.random.split(state.key, 3)
-        z = jax.random.normal(kz, (self.pop_size, self.dim))
-        r = jax.random.normal(kr, (self.pop_size, self.m))
-        # y = sqrt(1-ccov)^m z + sum_i sqrt(ccov (1-ccov)^(m-i)) r_i P_i
+    def _compose(self, z: jax.Array, r: jax.Array, P: jax.Array) -> jax.Array:
+        """y = sqrt(1-ccov)^m z + sum_i sqrt(ccov (1-ccov)^(m-1-i)) r_i P_i
+        — the low-rank direction composition, shared by the legacy and
+        per-shard sampling paths (only the key derivation may differ)."""
         a = math.sqrt(1 - self.ccov)
         y = (a**self.m) * z
         for i in range(self.m):
             coef = math.sqrt(self.ccov) * (a ** (self.m - 1 - i))
-            y = y + coef * r[:, i : i + 1] * state.P[i]
+            y = y + coef * r[:, i : i + 1] * P[i]
+        return y
+
+    def ask(self, state: RMESState) -> Tuple[jax.Array, RMESState]:
+        key, kz, kr = jax.random.split(state.key, 3)
+        z = jax.random.normal(kz, (self.pop_size, self.dim))
+        r = jax.random.normal(kr, (self.pop_size, self.m))
+        y = self._compose(z, r, state.P)
         pop = state.mean + state.sigma * y
         return pop, state.replace(z=y, key=key)  # store the composed direction
 
-    def tell(self, state: RMESState, fitness: jax.Array) -> RMESState:
-        order = jnp.argsort(fitness)
-        y_sel = state.z[order][: self.mu]
-        y_w = self.weights @ y_sel
+    # ----------------------------------------- sharded low-memory protocol
+    def ask_rows(self, state: RMESState, key: jax.Array, n_rows: int):
+        kz, kr = jax.random.split(key)
+        z = jax.random.normal(kz, (n_rows, self.dim))
+        r = jax.random.normal(kr, (n_rows, self.m))
+        y = self._compose(z, r, state.P)
+        return state.mean + state.sigma * y, {"z": y}
+
+    def rank_weights(self, ranks: jax.Array) -> jax.Array:
+        return weights_at_ranks(self.weights, ranks, self.mu)
+
+    def pop_moments(self, rows, weights: jax.Array):
+        return {"yw": weights @ rows["z"]}
+
+    def tell_with_moments(
+        self, state: RMESState, moments, fitness: jax.Array
+    ) -> RMESState:
+        y_w = moments["yw"]
+        # PSR bookkeeping needs the top-mu SORTED fitness — fitness-sized
+        # work, replicated cheaply on every device (never (pop, dim)); the
+        # replicated tell already sorted and threads it in via `f_sel`
+        f_sel = moments.get("f_sel")
+        if f_sel is None:
+            f_sel = jnp.sort(fitness)[: self.mu]
         mean = state.mean + state.sigma * y_w
         pc = (1 - self.cc) * state.pc + math.sqrt(
             self.cc * (2 - self.cc) * self.mueff
@@ -115,7 +158,6 @@ class RMES(Algorithm):
         p_iters = jnp.where(gap_ok, shifted_it, replaced_it)
 
         # population success rule (PSR) step-size adaptation
-        f_sel = fitness[order][: self.mu]
         merged = jnp.concatenate([f_sel, state.prev_fitness])
         ranks = jnp.argsort(jnp.argsort(merged)).astype(jnp.float32)
         q = (jnp.mean(ranks[self.mu :]) - jnp.mean(ranks[: self.mu])) / self.mu
@@ -130,3 +172,8 @@ class RMES(Algorithm):
             mean=mean, sigma=sigma, pc=pc, P=P, p_iters=p_iters,
             prev_fitness=f_sel, s=s, iteration=it,
         )
+
+    def tell(self, state: RMESState, fitness: jax.Array) -> RMESState:
+        moments, order = sorted_selection_moments(self, state, fitness)
+        moments = dict(moments, f_sel=fitness[order][: self.mu])
+        return self.tell_with_moments(state, moments, fitness)

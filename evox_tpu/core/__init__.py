@@ -5,11 +5,14 @@ from .monitor import Monitor, HOOK_NAMES
 from .distributed import (
     POP_AXIS,
     TENANT_AXIS,
+    ShardedES,
+    annotation_specs,
     match_partition_rules,
     create_mesh,
     pop_sharding,
     replicated_sharding,
     shard_pop,
+    sharded_es_tell,
     replicate,
     all_gather,
     tree_all_gather,
@@ -68,6 +71,9 @@ __all__ = [
     "HOOK_NAMES",
     "POP_AXIS",
     "TENANT_AXIS",
+    "ShardedES",
+    "annotation_specs",
+    "sharded_es_tell",
     "match_partition_rules",
     "create_mesh",
     "pop_sharding",

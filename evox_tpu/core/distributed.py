@@ -22,6 +22,7 @@ import re
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -43,10 +44,13 @@ __all__ = [
     "replicate",
     "match_partition_rules",
     "state_sharding",
+    "annotation_specs",
     "constrain_state",
     "place_state",
     "all_gather",
     "tree_all_gather",
+    "ShardedES",
+    "sharded_es_tell",
     "init_distributed",
     "process_id",
     "process_count",
@@ -304,6 +308,16 @@ def place_state(
     return jax.tree.map(jax.device_put, state, shardings)
 
 
+def annotation_specs(state: Any, default: "P" = P()) -> Any:
+    """A pytree of ``PartitionSpec`` matching ``state``, resolved purely
+    from the per-field ``field(sharding=...)`` annotations (the mesh-free
+    sibling of :func:`state_sharding`) — e.g. the ``in_specs`` of a
+    ``shard_map`` island over an annotated state (:class:`ShardedES`)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: _spec_for_path(state, path, default), state
+    )
+
+
 def place_pop(tree: Any, mesh: Optional[Mesh], axis_name: str = POP_AXIS) -> Any:
     """EAGER placement: ``device_put`` every leaf with its leading axis
     sharded over ``axis_name``. Use when loading host data or a restored
@@ -322,6 +336,296 @@ def all_gather(x: jax.Array, axis_name: str = POP_AXIS, tiled: bool = True) -> j
 
 def tree_all_gather(tree: Any, axis_name: str = POP_AXIS, tiled: bool = True) -> Any:
     return jax.tree.map(lambda x: all_gather(x, axis_name, tiled), tree)
+
+
+# --------------------------------------------------------------------------
+# Gather-free POP-sharded large-population ES (PR 10, ROADMAP item 4).
+#
+# "Massively parallel CMA-ES with increasing population" (PAPERS.md) shows
+# the CMA family keeps improving at pop ~ 1e4..1e6 on parallel hardware —
+# but a naive mesh run still materializes the full (pop, dim) sample matrix
+# on every device: jax.random's default threefry is non-partitionable (each
+# device generates the FULL matrix and slices its shard), and the
+# sort-select-recombine tell gathers the population to apply `z[order][:mu]`.
+# The two pieces below close both holes for the low-memory CMA track
+# (SepCMAES / LMMAES / RMES — diagonal / low-rank covariance):
+#
+# - sampling: each device draws only its own (pop/n_dev, dim) block from a
+#   fold_in-derived per-shard stream inside a shard_map island
+#   (`ShardedES.ask`);
+# - recombination: "sort, select mu, dot with weights" is reformulated as
+#   "weight every candidate by its global fitness RANK and sum" — ranks are
+#   fitness-sized (pop floats, cheap to replicate), the weighted sums are
+#   (dim,)-sized moments accumulated per shard and `psum`-reduced
+#   (`sharded_es_tell`), and the weight table lookup is bitwise-identical
+#   to the sorted-selection weights, so sharded == replicated up to
+#   summation order (documented tolerance, tests/test_state_contracts.py).
+#
+# Per-device peak memory therefore scales as pop/n_dev, verified by AOT
+# `memory_analysis()` + compiled-HLO inspection (tests/test_large_pop.py).
+
+
+def _require_shard_protocol(algorithm: Any) -> None:
+    missing = [
+        name
+        for name in ("ask_rows", "rank_weights", "pop_moments", "tell_with_moments")
+        if not callable(getattr(algorithm, name, None))
+    ]
+    if missing or not getattr(algorithm, "pop_shard_capable", False):
+        raise TypeError(
+            f"{type(algorithm).__name__} does not implement the POP-sharded "
+            "low-memory ES protocol (pop_shard_capable + ask_rows/"
+            "rank_weights/pop_moments/tell_with_moments); capable "
+            "algorithms: the low-memory CMA track (SepCMAES, LMMAES, RMES)"
+            + (f"; missing: {missing}" if missing else "")
+        )
+
+
+def sharded_es_tell(
+    algorithm: Any,
+    state: Any,
+    fitness: jax.Array,
+    mesh: Mesh,
+    axis_name: str = POP_AXIS,
+) -> Any:
+    """One gather-free ``tell`` over a POP-sharded sample matrix.
+
+    Global fitness ranks are computed in the surrounding (GSPMD) program —
+    fitness is ``(pop,)``-sized, cheap to gather/replicate — then a
+    ``shard_map`` island turns each device's ``(pop/n_dev, dim)`` artifact
+    shard into weighted partial moments and ``psum``s them; the small
+    replicated strategy-state update (``tell_with_moments``) runs on the
+    reduced ``(dim,)``/``(k, dim)`` moments. No collective ever moves a
+    ``(pop, dim)`` operand. Works unchanged on a (TENANT, POP) 2-D mesh
+    (PR 7): specs name only the ``pop`` axis, so tenant rows replicate."""
+    if fitness.ndim != 1:
+        raise ValueError(
+            f"sharded_es_tell is single-objective; got fitness {fitness.shape}"
+        )
+    from ..utils.compat import shard_map  # deferred: utils import cycle-safe
+
+    fields = tuple(algorithm.sharded_pop_fields)
+    rows = {name: getattr(state, name) for name in fields}
+    # global 0-based ranks as the scatter-inverse of ONE stable argsort
+    # (identical to the classic double argsort — ties break by index,
+    # exactly like the replicated z[argsort(fitness)][:mu] selection —
+    # but one pop-sized sort cheaper)
+    order = jnp.argsort(fitness)
+    ranks = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype)
+    )
+
+    def island(rows_local, ranks_local):
+        w_local = algorithm.rank_weights(ranks_local)
+        return jax.lax.psum(
+            algorithm.pop_moments(rows_local, w_local), axis_name
+        )
+
+    moments = shard_map(
+        island,
+        mesh=mesh,
+        in_specs=(
+            {name: P(axis_name) for name in fields},
+            P(axis_name),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )(rows, ranks)
+    # reuse the rank sort for the top-mu SORTED fitness (fitness-sized
+    # gather, no second pop-sized sort): RMES's PSR consumes it via the
+    # same `f_sel` key the replicated tell threads; algorithms that don't
+    # read it cost nothing (XLA dead-code-eliminates the gather)
+    moments = dict(moments, f_sel=fitness[order][: algorithm.mu])
+    return algorithm.tell_with_moments(state, moments, fitness)
+
+
+class ShardedES:
+    """Wrap a low-memory ES (SepCMAES / LMMAES / RMES) so every
+    per-candidate array stays POP-sharded: per-shard sampling in ``ask``,
+    psum-of-moments recombination in ``tell`` (:func:`sharded_es_tell`).
+
+    Drop-in :class:`~evox_tpu.core.algorithm.Algorithm`: state type, field
+    annotations and hyperparameter attributes are the wrapped algorithm's
+    (attribute reads forward), so it composes with ``StdWorkflow`` (pass
+    the same ``mesh``), :class:`~evox_tpu.core.guardrail.GuardedAlgorithm`
+    (wrap OUTSIDE: ``GuardedAlgorithm(ShardedES(algo, mesh))``),
+    ``DtypePolicy`` bf16 storage, donated fused runs, the
+    ``GenerationExecutor``, and IPOP handoff
+    (``IPOPRestarts(handoff_factory=...)``).
+
+    Sampling law: ``ask`` splits the state key once, then shard ``s`` draws
+    its block from ``fold_in(k, s)`` — on the mesh each device computes
+    only its own block inside a ``shard_map`` island (jax's default
+    threefry is NOT partitionable, so constraining a plain
+    ``jax.random.normal`` would still materialize the full matrix per
+    device). ``mesh=None`` with ``n_shards=N`` runs the SAME law
+    replicated (concatenated blocks) — the reference the sharded path is
+    tested against (bitwise-equal samples, psum-order-only differences).
+    ``mesh=None, n_shards=1`` is the wrapped algorithm's legacy stream,
+    bit-identical to the bare algorithm.
+
+    Args:
+        algorithm: a ``pop_shard_capable`` algorithm (the low-memory CMA
+            track). Population size must divide ``n_shards``.
+        mesh: mesh with a ``axis_name`` axis — 1-D ``(POP,)`` or the
+            (TENANT, POP) 2-D mesh of workflows/tenancy.py (tenant rows
+            replicate the strategy state; specs name only the pop axis).
+        axis_name: mesh axis to shard the population over.
+        n_shards: sampling-law shard count; defaults to the mesh's
+            ``axis_name`` size (or 1 without a mesh). Pass explicitly on
+            ``mesh=None`` to build the replicated reference of an n-device
+            sharded run.
+    """
+
+    is_pop_sharded = False  # overridden per instance when a mesh is given
+
+    def __init__(
+        self,
+        algorithm: Any,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = POP_AXIS,
+        n_shards: Optional[int] = None,
+    ):
+        _require_shard_protocol(algorithm)
+        if getattr(algorithm, "has_init_ask", False) or getattr(
+            algorithm, "has_init_tell", False
+        ):
+            raise TypeError(
+                "ShardedES supports steady-state ask/tell algorithms only "
+                f"({type(algorithm).__name__} declares init_ask/init_tell)"
+            )
+        self.algorithm = algorithm
+        self.mesh = mesh
+        self.axis_name = axis_name
+        if n_shards is None:
+            n_shards = int(mesh.shape[axis_name]) if mesh is not None else 1
+        self.n_shards = int(n_shards)
+        if mesh is not None and int(mesh.shape[axis_name]) != self.n_shards:
+            raise ValueError(
+                f"n_shards={self.n_shards} disagrees with the mesh's "
+                f"'{axis_name}' axis ({int(mesh.shape[axis_name])})"
+            )
+        pop = int(algorithm.pop_size)
+        if pop % self.n_shards != 0:
+            raise ValueError(
+                f"pop_size {pop} is not divisible by n_shards={self.n_shards}"
+            )
+        self.is_pop_sharded = mesh is not None
+
+    def __getattr__(self, name: str) -> Any:
+        # only reached when normal lookup fails: forward hyperparameter
+        # reads (pop_size, dim, mu, weights, ...) to the wrapped algorithm
+        if name.startswith("__") or name == "algorithm":
+            raise AttributeError(name)
+        return getattr(object.__getattribute__(self, "algorithm"), name)
+
+    # the steady-state-only contract asserted in __init__
+    @property
+    def has_init_ask(self) -> bool:
+        return False
+
+    @property
+    def has_init_tell(self) -> bool:
+        return False
+
+    def _rename_axis(self, spec: "P") -> "P":
+        """Field annotations name the canonical ``POP_AXIS``; substitute
+        this wrapper's ``axis_name`` when the mesh calls it differently."""
+        if self.axis_name == POP_AXIS:
+            return spec
+        return P(*(self.axis_name if ax == POP_AXIS else ax for ax in spec))
+
+    def _state_shardings(self, state: Any) -> Any:
+        """Per-leaf ``NamedSharding`` from the field annotations, with the
+        pop axis renamed to ``axis_name`` (the placement twin of
+        :meth:`_state_specs`)."""
+        return jax.tree_util.tree_map(
+            lambda sp: NamedSharding(self.mesh, sp),
+            self._state_specs(state),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    # ------------------------------------------------------------------ api
+    def init(self, key: jax.Array) -> Any:
+        if self.mesh is None:
+            return self.algorithm.init(key)
+        if isinstance(key, jax.core.Tracer):
+            # inside a trace (e.g. GuardedAlgorithm's on-device restart):
+            # constrain instead of placing — GSPMD lays the fresh state out
+            state = self.algorithm.init(key)
+            return jax.tree.map(
+                jax.lax.with_sharding_constraint,
+                state,
+                self._state_shardings(state),
+            )
+        # eager: compile init with its OUTPUT shardings pinned to the field
+        # annotations, so the (pop, dim) buffers are born sharded — never
+        # materialized on one device and re-placed
+        sds = jax.eval_shape(self.algorithm.init, key)
+        shardings = self._state_shardings(sds)
+        return jax.jit(self.algorithm.init, out_shardings=shardings)(key)
+
+    def ask(self, state: Any) -> Tuple[Any, Any]:
+        if self.mesh is None and self.n_shards == 1:
+            return self.algorithm.ask(state)  # legacy stream, bare-identical
+        key, k = jax.random.split(state.key)
+        shard = int(self.algorithm.pop_size) // self.n_shards
+        fields = tuple(self.algorithm.sharded_pop_fields)
+        if self.mesh is None:
+            # replicated reference of the per-shard sampling law
+            pops, arts = [], []
+            for s in range(self.n_shards):
+                p, a = self.algorithm.ask_rows(
+                    state, jax.random.fold_in(k, s), shard
+                )
+                pops.append(p)
+                arts.append(a)
+            pop = jnp.concatenate(pops)
+            art = {
+                name: jnp.concatenate([a[name] for a in arts])
+                for name in fields
+            }
+        else:
+            from ..utils.compat import shard_map  # deferred (cycle-safe)
+
+            axis = self.axis_name
+
+            def island(st, k_op):
+                s = jax.lax.axis_index(axis)
+                return self.algorithm.ask_rows(
+                    st, jax.random.fold_in(k_op, s), shard
+                )
+
+            pop, art = shard_map(
+                island,
+                mesh=self.mesh,
+                # the state rides in under its own field annotations (the
+                # (pop, dim) artifact enters as a local shard, unused by
+                # ask_rows; the small strategy fields replicate), with the
+                # annotations' POP_AXIS renamed to this wrapper's axis
+                in_specs=(self._state_specs(state), P()),
+                out_specs=(P(axis), {name: P(axis) for name in fields}),
+                check_vma=False,
+            )(state, k)
+        return pop, state.replace(key=key, **art)
+
+    def _state_specs(self, state: Any) -> Any:
+        """Per-leaf shard_map specs from the field annotations
+        (:func:`annotation_specs`), with ``POP_AXIS`` substituted by this
+        wrapper's ``axis_name`` (the annotations name the canonical axis;
+        the mesh may not)."""
+        return jax.tree_util.tree_map(
+            self._rename_axis,
+            annotation_specs(state),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def tell(self, state: Any, fitness: jax.Array) -> Any:
+        if self.mesh is None:
+            return self.algorithm.tell(state, fitness)
+        return sharded_es_tell(
+            self.algorithm, state, fitness, self.mesh, self.axis_name
+        )
 
 
 def init_distributed(

@@ -409,6 +409,8 @@ class GuardedAlgorithm(Algorithm):
             "restarts": int(state.restarts),
             "stagnation": int(state.stagnation),
             "best_fitness": float(state.best_fitness),
+            "pop_size": int(state.pop_size),
+            "algorithm": type(self.algorithm).__name__,
             "last_trigger": trig,
             "last_trigger_names": [
                 name
@@ -446,6 +448,19 @@ class IPOPRestarts:
             stagnation counter reaches this limit, even if no on-device
             restart fired (lets the device wrapper keep only cheap NaN /
             sigma guards while the host owns stagnation escalation).
+        handoff_pop: population threshold for the low-memory handoff —
+            once a doubling reaches/crosses it, the restart builds from
+            ``handoff_factory`` instead of ``algorithm_factory``, so IPOP
+            growth escapes the dense track's single-device memory/eigh
+            wall (``es.common.EighScaleError``) onto the sharded
+            low-memory track (e.g. ``GuardedAlgorithm(ShardedES(
+            SepCMAES(...), mesh))``). Must be paired with
+            ``handoff_factory``. The handoff is deterministic in
+            ``pop_size``, so checkpointed resumes rebuild the identical
+            (possibly handed-off) program; each handoff is surfaced in
+            ``run_report()["guardrail"]["ipop"]``.
+        handoff_factory: ``pop_size -> GuardedAlgorithm`` over the
+            low-memory track, used at/past ``handoff_pop``.
     """
 
     def __init__(
@@ -455,6 +470,8 @@ class IPOPRestarts:
         growth: int = 2,
         check_every: int = 50,
         stagnation_limit: Optional[int] = None,
+        handoff_pop: Optional[int] = None,
+        handoff_factory=None,
     ):
         if max_restarts < 0:
             raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
@@ -462,18 +479,34 @@ class IPOPRestarts:
             raise ValueError(f"growth must be >= 2, got {growth}")
         if check_every < 1:
             raise ValueError(f"check_every must be >= 1, got {check_every}")
+        if (handoff_pop is None) != (handoff_factory is None):
+            raise ValueError(
+                "handoff_pop and handoff_factory must be given together"
+            )
         self.algorithm_factory = algorithm_factory
         self.max_restarts = max_restarts
         self.growth = growth
         self.check_every = check_every
         self.stagnation_limit = stagnation_limit
+        self.handoff_pop = handoff_pop
+        self.handoff_factory = handoff_factory
+
+    def uses_handoff(self, pop_size: int) -> bool:
+        """Whether a (re)build at ``pop_size`` lands on the low-memory
+        handoff track — pure in ``pop_size`` so resumes re-derive it."""
+        return self.handoff_pop is not None and pop_size >= self.handoff_pop
 
     def make_algorithm(self, pop_size: int) -> "GuardedAlgorithm":
-        algo = self.algorithm_factory(pop_size)
+        factory = (
+            self.handoff_factory
+            if self.uses_handoff(pop_size)
+            else self.algorithm_factory
+        )
+        algo = factory(pop_size)
         if not isinstance(algo, GuardedAlgorithm):
             raise TypeError(
-                "IPOPRestarts.algorithm_factory must return a "
-                "GuardedAlgorithm (the on-device detector the host "
-                f"boundary reads); got {type(algo).__name__}"
+                "IPOPRestarts factories must return a GuardedAlgorithm "
+                "(the on-device detector the host boundary reads); got "
+                f"{type(algo).__name__}"
             )
         return algo

@@ -470,6 +470,69 @@ def instrument(
     return recorder
 
 
+def _sharding_subsection(
+    workflow: Any, state: Any, analyses: Dict[str, dict]
+) -> Optional[dict]:
+    """The roofline ``sharding`` subsection (schema v5): for a workflow
+    driving a POP-sharded algorithm (``core.distributed.ShardedES``,
+    duck-typed via ``is_pop_sharded``), compare the AOT PER-DEVICE peak
+    bytes of the steady entry point against the FULL-POP artifact bytes of
+    the algorithm state — a gather-free compiled step must keep the former
+    strictly below the latter (``memory_analysis()`` reports per-device
+    sizes for SPMD programs; verified in tests/test_large_pop.py)."""
+    algo = getattr(workflow, "algorithm", None)
+    if not getattr(algo, "is_pop_sharded", False):
+        return None
+    n_dev = int(getattr(algo, "n_shards", 1) or 1)
+    if n_dev < 4:
+        # the inequality is meaningful only when the shard is a small
+        # fraction of the population: per-device peak carries a constant
+        # factor (z in+out, candidates, temps) of roughly 2-4x one shard,
+        # so at n_dev < 4 even a perfectly gather-free program can sit at
+        # or above full-pop bytes — no claim is attached rather than a
+        # false "not gather-free" rejection
+        return None
+    pop = int(getattr(algo, "pop_size", 0) or 0)
+    astate = getattr(state, "algo", None)
+    full = 0
+    for leaf in jax.tree_util.tree_leaves(astate):
+        shape = getattr(leaf, "shape", ())
+        if pop and len(shape) >= 1 and shape[0] == pop:
+            # count float artifacts at the COMPUTE width (>= 4 bytes):
+            # under a bf16 storage policy the leaves REST at half width
+            # but the in-step temps the peak actually measures are f32
+            # (apply_compute upcasts at step entry) — comparing an f32
+            # peak against a bf16-sized reference would falsely fail
+            # legitimate gather-free bf16 runs
+            itemsize = np.dtype(leaf.dtype).itemsize
+            if np.issubdtype(np.dtype(leaf.dtype), np.floating):
+                itemsize = max(itemsize, 4)
+            full += int(np.prod(shape)) * itemsize
+    if full < 4 * 1024 * 1024:
+        # the inequality discriminates only when the full-pop artifacts
+        # dominate the per-device FIXED footprint (replicated strategy
+        # fields, monitor rings, program temps); a small-pop sharded run
+        # is legitimate but proves nothing either way — no claim attached
+        # rather than a false "not gather-free" rejection
+        return None
+    for entry in ("step", "run"):
+        analysis = analyses.get(entry)
+        if not isinstance(analysis, dict) or "error" in analysis:
+            continue
+        peak = (analysis.get("memory") or {}).get("peak_bytes_estimate")
+        if peak:
+            return {
+                "axis": str(getattr(algo, "axis_name", "pop")),
+                "n_devices": int(getattr(algo, "n_shards", 1) or 1),
+                "pop_size": pop,
+                "entry": entry,
+                "per_device_peak_bytes": int(peak),
+                "full_pop_bytes": int(full),
+                "gather_free": int(peak) < int(full),
+            }
+    return None
+
+
 def run_report(
     workflow: Any = None,
     state: Any = None,
@@ -509,7 +572,11 @@ def run_report(
     # (multi-tenant fleets, workflows/tenancy.py). v4 adds the optional
     # `executor` section (core/executor.py GenerationExecutor: queue
     # depth, overlap spans, staleness counters) — validated when present.
-    report: dict = {"schema": "evox_tpu.run_report/v4"}
+    # v5 adds the optional roofline `sharding` subsection (POP-sharded
+    # large-pop runs: per-device peak bytes vs the full-pop bytes — the
+    # gather-free acceptance signal) and `guardrail.ipop` (host-boundary
+    # doubling/handoff events) — both validated when present.
+    report: dict = {"schema": "evox_tpu.run_report/v5"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -541,6 +608,12 @@ def run_report(
         astate = getattr(state, "algo", None)
         if hasattr(algo, "health_report") and hasattr(astate, "restarts"):
             report["guardrail"] = algo.health_report(astate)
+        # host-boundary IPOP history (workflows/ipop.py): doubling and
+        # low-memory handoff events recorded on the caller's workflow
+        # object (clones share the list) — duck-typed like _run_supervisor
+        ipop_events = getattr(workflow, "_ipop_events", None)
+        if ipop_events:
+            report.setdefault("guardrail", {})["ipop"] = list(ipop_events)
     summary = recorder.summary() if recorder is not None else None
     if summary is not None:
         report["dispatch"] = summary
@@ -585,6 +658,16 @@ def run_report(
                     if isinstance(a, dict) and "error" not in a
                 },
             }
+            # POP-sharded large-pop provenance (schema v5, PR 10): when the
+            # workflow drives a ShardedES-backed algorithm, record the AOT
+            # per-device peak next to the full-pop artifact bytes — the
+            # "per-device memory scales as pop/n_dev, not pop" acceptance
+            # signal (tools/check_report.py asserts peak < full-pop bytes)
+            sharding = _sharding_subsection(
+                workflow, state, analyzer.analyses
+            )
+            if sharding is not None:
+                report["roofline"]["sharding"] = sharding
     if supervisor is None and workflow is not None:
         supervisor = getattr(workflow, "_run_supervisor", None)
     if supervisor is not None and hasattr(supervisor, "report"):

@@ -91,12 +91,46 @@ def ipop_run(
     ``chunk`` generations of ``wf`` — everything between host checks stays
     whatever dispatch shape the caller already uses."""
     base_pop = int(wf.algorithm.pop_size)
+    # eager schedule validation: every pop size the doubling schedule can
+    # reach must be CONSTRUCTIBLE now — a dense-track EighScaleError (or a
+    # sharded-track divisibility error) should abort at entry, not hours
+    # in at a mid-run host boundary after the compute is already spent
+    # (constructors are pure and cheap; the compiled programs are not
+    # built here)
+    for used in range(1, policy.max_restarts + 1):
+        policy.make_algorithm(base_pop * policy.growth**used)
+    # escalation events land on the CALLER's workflow object (and every
+    # clone), so run_report(workflow=wf, ...) surfaces the doubling/handoff
+    # history in its `guardrail.ipop` section even though clones replace
+    # the driving workflow at each boundary (instrument.py picks this up
+    # duck-typed, like workflow._run_supervisor)
+    root_wf = wf
+    events = list(getattr(wf, "_ipop_events", []))
+    root_wf._ipop_events = events
     if resume_from is not None:
         wf, state, n_steps, resumed_ckpt = resolve_ipop_resume(
             wf, policy, state, n_steps, resume_from
         )
         if checkpointer is None:
             checkpointer = resumed_ckpt
+        # pre-crash doublings happened in another process: their event
+        # records are gone, but the snapshot's static pop_size re-derives
+        # how far the schedule got — seed the history with ONE summary
+        # entry so the report still explains the current algorithm/track
+        snap_pop = int(getattr(state.algo, "pop_size", 0) or base_pop)
+        used = _doublings_used(policy, base_pop, snap_pop)
+        if used > 0 and not events:
+            events.append(
+                {
+                    "resumed": True,  # generation stamps not recoverable
+                    "generation": int(state.generation),
+                    "pop_size": snap_pop,
+                    "doublings": used,
+                    "handoff": bool(policy.uses_handoff(snap_pop)),
+                    "algorithm": type(wf.algorithm.algorithm).__name__,
+                }
+            )
+        wf._ipop_events = events
     _require_guarded(state.algo)
 
     # Determinism contract (asserted in tests/test_numeric_chaos.py): a
@@ -148,8 +182,23 @@ def _maybe_double(
     # -------------------------------------------------------- double λ
     used += 1
     new_pop = base_pop * policy.growth**used
+    # make_algorithm routes through the low-memory handoff_factory at/past
+    # handoff_pop (core/guardrail.py IPOPRestarts) — doubling escapes the
+    # dense track's eigh/memory wall instead of marching into it
+    events = getattr(wf, "_ipop_events", None)  # shared with the root wf
     algo2 = policy.make_algorithm(new_pop)
     wf = wf.clone_with_algorithm(algo2)
+    if events is not None:
+        events.append(
+            {
+                "generation": int(state.generation),
+                "pop_size": int(new_pop),
+                "doublings": int(used),
+                "handoff": bool(policy.uses_handoff(new_pop)),
+                "algorithm": type(algo2.algorithm).__name__,
+            }
+        )
+        wf._ipop_events = events
     # fresh state from the wrapper's restart stream (folded per doubling:
     # deterministic, so a resumed run re-derives the identical successor)
     fresh = algo2.init(jax.random.fold_in(algo_state.key, used))

@@ -57,6 +57,64 @@ def test_validator_catches_regressions():
     assert "non-finite" in errors
 
 
+def test_validator_sharding_subsection_rules():
+    """v5 roofline.sharding (PR 10): a well-formed gather-free section
+    passes; per-device peak >= full-pop bytes (a gathered step), a denied
+    gather_free flag, or missing fields fail."""
+    report = _fresh_report(True)
+    good = json.loads(json.dumps(report))
+    good["roofline"]["sharding"] = {
+        "axis": "pop",
+        "n_devices": 8,
+        "pop_size": 1 << 15,
+        "entry": "step",
+        "per_device_peak_bytes": 5_000_000,
+        "full_pop_bytes": 8_388_608,
+        "gather_free": True,
+    }
+    assert check_report.validate_run_report(good) == []
+    bad = json.loads(json.dumps(good))
+    bad["roofline"]["sharding"]["per_device_peak_bytes"] = 9_000_000
+    bad["roofline"]["sharding"]["gather_free"] = False
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "not gather-free" in errors and "gather_free" in errors
+    bad2 = json.loads(json.dumps(good))
+    del bad2["roofline"]["sharding"]["n_devices"]
+    assert any(
+        "sharding.n_devices" in e
+        for e in check_report.validate_run_report(bad2)
+    )
+
+
+def test_validator_large_pop_leg_rules():
+    """A 'large-pop' bench leg without its measured replicated-baseline
+    ratio (or ratio_rounds) is an asserted win — rejected; and a
+    large_pop summary whose instrumented report lacks the sharding
+    subsection is an unmeasured gather-free claim — rejected."""
+    summary = {
+        "metric": "geomean",
+        "value": 1.0,
+        "unit": "x",
+        "sub_metrics": [
+            {
+                "metric": "Sharded large-pop SepCMAES evals/sec",
+                "value": 1.0e6,
+                "unit": "evals/sec",
+                "vs_baseline": None,
+                "ratio_rounds": None,
+            }
+        ],
+    }
+    errors = "\n".join(check_report.validate_bench(summary))
+    assert "large-pop" in errors and "replicated-baseline" in errors
+    summary["sub_metrics"][0]["vs_baseline"] = 1.01
+    summary["sub_metrics"][0]["ratio_rounds"] = [1.0, 1.01]
+    assert check_report.validate_bench(summary) == []
+    summary["large_pop"] = {"run_report": _fresh_report(True)}
+    errors = "\n".join(check_report.validate_bench(summary))
+    assert "roofline.sharding missing" in errors
+
+
 def test_bench_jsons_validate():
     """Every BENCH_*.json the driver has captured must either validate as
     a bench summary or be a truncated capture (some historical envelopes

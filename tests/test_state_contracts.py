@@ -345,6 +345,63 @@ def test_algorithm_vmap_contract(name):
         assert a.dtype == jnp.asarray(b).dtype, f"{where}: dtype changed"
 
 
+# ---------------------------------------------------------------- sharded ES
+# PR 10: every algorithm advertising the POP-sharded low-memory protocol
+# (pop_shard_capable) must run one full ask/tell under ShardedES on the
+# 8-device mesh and match the replicated path of the SAME per-shard
+# sampling law. Documented tolerance: samples are bitwise-identical
+# (identical per-shard streams), state updates differ only by summation
+# order (psum-of-partial-moments vs one ordered reduction) — rtol/atol
+# 1e-5 at these shapes; multi-step trajectories drift gradually toward
+# ~1e-4 (see tests/test_large_pop.py for trajectory + convergence laws).
+
+SHARDED_TRACK_BASELINE = {"SepCMAES", "LMMAES", "RMES"}
+
+
+def _sharded_capable():
+    return {
+        name: algo
+        for name, algo in _constructible().items()
+        if getattr(algo, "pop_shard_capable", False)
+    }
+
+
+def test_sharded_track_baseline():
+    """The sharded low-memory track covers at least the PR-10 set; a new
+    pop_shard_capable algorithm joins the mechanical contract for free."""
+    got = set(_sharded_capable())
+    missing = SHARDED_TRACK_BASELINE - got
+    assert not missing, f"sharded track lost algorithms: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("name", sorted(_sharded_capable()))
+def test_sharded_step_contract(name):
+    from evox_tpu.core.distributed import ShardedES, create_mesh
+
+    algo = _sharded_capable()[name]
+    mesh = create_mesh()
+    n_dev = jax.device_count()
+    sharded = ShardedES(algo, mesh=mesh)
+    repl = ShardedES(algo, mesh=None, n_shards=n_dev)
+    key = jax.random.PRNGKey(5)
+    s_sh, s_rp = sharded.init(key), repl.init(key)
+    pop_sh, s_sh = sharded.ask(s_sh)
+    pop_rp, s_rp = repl.ask(s_rp)
+    # identical per-shard streams: the samples agree to fp noise
+    assert jnp.allclose(pop_sh, pop_rp, rtol=1e-6, atol=1e-6), name
+    fit = jnp.sum(jnp.asarray(pop_sh, jnp.float32) ** 2, axis=1)
+    s_sh = sharded.tell(s_sh, fit)
+    s_rp = repl.tell(s_rp, jnp.sum(jnp.asarray(pop_rp, jnp.float32) ** 2, axis=1))
+    sh_leaves = jax.tree_util.tree_flatten_with_path(s_sh)[0]
+    rp_leaves = jax.tree_util.tree_flatten_with_path(s_rp)[0]
+    assert len(sh_leaves) == len(rp_leaves)
+    for (path, a), (_, b) in zip(sh_leaves, rp_leaves):
+        assert jnp.allclose(a, b, rtol=1e-5, atol=1e-5), (
+            f"{name}{jax.tree_util.keystr(path)}: sharded tell diverged "
+            "from the replicated path beyond the documented tolerance"
+        )
+
+
 def test_monitor_state_contracts():
     """Monitor states: frozen pytree dataclasses, all fields P() (their
     buffers are capacity-leading, not population-leading)."""

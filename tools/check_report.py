@@ -227,6 +227,16 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                         errors.append(
                             f"{where}: roofline.dtype_policy.active missing"
                         )
+                # PR-10 (schema v5+): POP-sharded large-pop runs carry a
+                # `sharding` subsection whose whole point is the
+                # gather-free inequality — per-device peak bytes must be
+                # strictly below the full-pop artifact bytes (a compiled
+                # step that gathers the population to one device fails
+                # here, not in a dashboard). Optional: replicated runs
+                # don't carry it.
+                shd = roofline.get("sharding")
+                if shd is not None:
+                    errors += _validate_sharding(shd, where)
                 don = roofline.get("donation")
                 if schema_version < 2:
                     pass
@@ -262,6 +272,40 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                             "pipeline_tell entries show zero alias bytes — "
                             "the aliasing never reached the compiled program"
                         )
+    return errors
+
+
+def _validate_sharding(shd: Any, where: str) -> List[str]:
+    """The roofline ``sharding`` subsection (schema v5, PR 10): a
+    POP-sharded run's AOT per-device peak vs full-pop bytes. The
+    inequality IS the acceptance criterion — per-device memory must scale
+    as pop/n_dev, so the per-device peak of a gather-free compiled step
+    sits strictly below the bytes of the full-population artifacts."""
+    errors: List[str] = []
+    if not isinstance(shd, dict):
+        return [f"{where}: roofline.sharding is not an object"]
+    if not isinstance(shd.get("axis"), str):
+        errors.append(f"{where}: roofline.sharding.axis missing")
+    for key in ("n_devices", "pop_size", "per_device_peak_bytes", "full_pop_bytes"):
+        v = shd.get(key)
+        if not isinstance(v, int) or v < 1:
+            errors.append(
+                f"{where}: roofline.sharding.{key} missing or not a "
+                "positive int"
+            )
+    peak, full = shd.get("per_device_peak_bytes"), shd.get("full_pop_bytes")
+    if isinstance(peak, int) and isinstance(full, int) and peak >= full:
+        errors.append(
+            f"{where}: roofline.sharding per_device_peak_bytes {peak} >= "
+            f"full_pop_bytes {full} — the compiled step materializes the "
+            "full population on one device (not gather-free)"
+        )
+    if shd.get("gather_free") is not True:
+        errors.append(
+            f"{where}: roofline.sharding.gather_free is not true — a "
+            "sharded run whose own report denies the gather-free property "
+            "must not ship"
+        )
     return errors
 
 
@@ -520,6 +564,7 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             ("bf16", "its f32 reference ratio"),
             ("tenant", "its sequential-baseline ratio"),
             ("overlap", "its sequential-loop ratio"),
+            ("large-pop", "its replicated-baseline ratio"),
         ):
             if keyword not in metric_l:
                 continue
@@ -543,6 +588,46 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
         errors += validate_run_report(
             ten["run_report"], where=f"{where}: tenancy.run_report"
         )
+    lp = summary.get("large_pop")
+    if isinstance(lp, dict):
+        if lp.get("run_report") is not None:
+            rr_lp = lp["run_report"]
+            errors += validate_run_report(
+                rr_lp, where=f"{where}: large_pop.run_report"
+            )
+            # the instrumented sharded sample must actually carry the
+            # gather-free evidence, not just the timing ratio — UNLESS
+            # the capture says why it legitimately cannot (the producer
+            # omits the subsection where its inequality does not
+            # discriminate: < 4 devices or a fixed-footprint-dominated
+            # shape; see core/instrument.py::_sharding_subsection)
+            if not isinstance(
+                (rr_lp.get("roofline") or {}).get("sharding"), dict
+            ) and not isinstance(lp.get("note"), str):
+                errors.append(
+                    f"{where}: large_pop.run_report.roofline.sharding "
+                    "missing without an explanatory note — the leg's "
+                    "gather-free claim is unmeasured"
+                )
+        table = lp.get("static_bytes")
+        if table is not None:
+            if not isinstance(table, dict):
+                errors.append(f"{where}: large_pop.static_bytes not an object")
+            else:
+                sh = table.get("sharded_per_device_peak_bytes")
+                rp = table.get("replicated_peak_bytes")
+                if not isinstance(sh, int) or not isinstance(rp, int):
+                    errors.append(
+                        f"{where}: large_pop.static_bytes needs int "
+                        "sharded_per_device_peak_bytes and "
+                        "replicated_peak_bytes"
+                    )
+                elif sh >= rp:
+                    errors.append(
+                        f"{where}: large_pop.static_bytes sharded per-device "
+                        f"peak {sh} >= replicated peak {rp} — sharding "
+                        "bought no memory"
+                    )
     ex = summary.get("executor")
     if isinstance(ex, dict):
         if ex.get("run_report") is not None:
