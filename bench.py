@@ -586,6 +586,21 @@ def tenancy_summary(results):
     st = wf_f.run(st, TEN_PAIR[1])
     rec.fetch(st.generation, name="fleet_generation")
     out["run_report"] = run_report(wf_f, st, recorder=rec)
+    # journaled serving sample (run_report v6): a small RunQueue sweep
+    # with the durable WAL + background fleet snapshots, so the capture
+    # carries the tenancy.queue.journal section check_report validates —
+    # serving durability is measured-in-report, not just asserted
+    import tempfile
+
+    from evox_tpu import RunQueue, TenantSpec
+
+    with tempfile.TemporaryDirectory() as td:
+        wf_q = VectorizedWorkflow(_tenancy_algo(), Sphere(), n_tenants=4)
+        q = RunQueue(wf_q, chunk=5, journal=td)
+        for i in range(6):
+            q.submit(TenantSpec(seed=i, n_steps=10, tag=f"bench{i}"))
+        q.run()
+        out["serving_run_report"] = run_report(wf_q, q.state)
     return out
 
 

@@ -632,6 +632,63 @@ class GenerationExecutor:
     # the lanes of the CURRENTLY running segments, for the restore rung
     _active_ckpt_lanes: List[_IoLane]
 
+    # ------------------------------------------------- named background lanes
+    def background_lane(self, name: str) -> _IoLane:
+        """A PERSISTENT ordered background I/O lane owned by this
+        executor (created lazily, one worker thread, bounded in-flight
+        with backpressure). Unlike the per-run checkpoint lanes, a named
+        lane survives across chunks/runs — the serving layer's
+        fleet-snapshot and journal traffic lives here, so every chunk's
+        snapshot pickle+fsync overlaps the next chunk's dispatch.
+        Registered with the restore-drain set: a supervisor restore rung
+        waits for these writes too before reading ``latest()``."""
+        lanes = getattr(self, "_named_lanes", None)
+        if lanes is None:
+            lanes = self._named_lanes = {}
+        lane = lanes.get(name)
+        if lane is None:
+            lane = lanes[name] = _IoLane(name, self.io_inflight)
+            active = getattr(self, "_active_ckpt_lanes", None)
+            if active is None:
+                active = self._active_ckpt_lanes = []
+            active.append(lane)
+        return lane
+
+    def submit_background(
+        self, name: str, fn: Callable[[], Any], counter: str = "bg_task"
+    ) -> None:
+        """Submit ``fn`` to the named persistent lane (ordered within the
+        lane; errors re-raise at the next submit/drain), counting it
+        under ``counter`` and recording a span for the trace."""
+        lane = self.background_lane(name)
+        self.counters[counter] = self.counters.get(counter, 0) + 1
+        t0 = self._clock()
+
+        def task():
+            try:
+                return fn()
+            finally:
+                self._span(f"io:{name}", counter, t0, self._clock() - t0)
+
+        lane.submit(task)
+        self._sample("executor/io_queue_depth", lane.depth())
+
+    def drain_lane(self, name: str) -> None:
+        """Join every pending task of a named lane (no-op for a name
+        that was never used), re-raising the first error — the serving
+        layer calls this at sweep completion so a failed background
+        fsync fails the sweep instead of vanishing."""
+        lane = getattr(self, "_named_lanes", {}).get(name)
+        if lane is not None:
+            lane.drain()
+            # fold the lane's busy time into overlap accounting as it
+            # quiesces (idempotent: busy_s is consumed and reset)
+            self.overlap["io_s"] += lane.busy_s
+            lane.busy_s = 0.0
+            self.queue_stats["io_inflight_max"] = max(
+                self.queue_stats["io_inflight_max"], lane.high_water
+            )
+
     def _drain_checkpoint_lanes(self) -> None:
         for lane in list(getattr(self, "_active_ckpt_lanes", [])):
             try:

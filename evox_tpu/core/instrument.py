@@ -575,8 +575,12 @@ def run_report(
     # v5 adds the optional roofline `sharding` subsection (POP-sharded
     # large-pop runs: per-device peak bytes vs the full-pop bytes — the
     # gather-free acceptance signal) and `guardrail.ipop` (host-boundary
-    # doubling/handoff events) — both validated when present.
-    report: dict = {"schema": "evox_tpu.run_report/v5"}
+    # doubling/handoff events) — both validated when present. v6 adds
+    # the serving fault-domain sections (workflows/journal.py +
+    # fleet_health.py): `tenancy.queue.journal` (hash-chained WAL event
+    # counters, recovered flag) and `tenancy.fleet_health` (per-tenant
+    # freeze/evict/restart action log) — validated when present.
+    report: dict = {"schema": "evox_tpu.run_report/v6"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:

@@ -6,6 +6,8 @@ from .checkpoint import (
 from .std import StdWorkflow, StdWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
 from .pipelined import run_host_pipelined
+from .journal import JournalIntegrityError, RunJournal
+from .fleet_health import FleetHealthPolicy, fleet_health_signals
 from .tenancy import (
     RunQueue,
     TenantSpec,
@@ -31,6 +33,10 @@ __all__ = [
     "WorkflowCheckpointer",
     "CheckpointConfigError",
     "restore_layouts",
+    "RunJournal",
+    "JournalIntegrityError",
+    "FleetHealthPolicy",
+    "fleet_health_signals",
     "run_host_pipelined",
     "RunSupervisor",
     "RunAbortedError",

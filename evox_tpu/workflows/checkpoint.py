@@ -67,6 +67,21 @@ import jax
 
 _SCHEMA = "evox_tpu.workflow_checkpoint/v1"
 
+# Crash-injection hook for the process-chaos harness (tests/_proc_chaos.py):
+# when set, it is called with a named point inside the durable-write path
+# ("pre_rename:<suffix>" before the atomic os.replace, "manifest_pending"
+# between a snapshot's committed data file and its manifest) — the chaos
+# child SIGKILLs itself there to reproduce a power-loss-shaped tear at an
+# exact byte boundary, including on the executor's BACKGROUND checkpoint
+# lane (the hook runs on whatever thread performs the write). Always None
+# in production; never set it outside tests.
+_CRASH_HOOK = None
+
+
+def _crash_point(point: str) -> None:
+    if _CRASH_HOOK is not None:
+        _CRASH_HOOK(point)
+
 
 class CheckpointConfigError(RuntimeError):
     """A snapshot's config fingerprint does not match the run asking to
@@ -113,6 +128,7 @@ def _write_durable(path: Path, payload: bytes, tmp_suffix: str) -> None:
         f.write(payload)
         f.flush()
         os.fsync(f.fileno())
+    _crash_point(f"pre_rename:{path.name}")
     os.replace(tmp, path)
     _fsync_path(path.parent)
 
@@ -215,6 +231,11 @@ class WorkflowCheckpointer:
         gen = int(host_state.generation)
         path = self.directory / f"ckpt_{gen:08d}.pkl"
         _write_durable(path, payload, ".pkl.tmp")
+        # a kill here (data durable, manifest not) must leave latest()
+        # on the PREVIOUS intact snapshot — the manifest is the commit
+        # record; asserted through the background lane by the process-
+        # chaos harness
+        _crash_point(f"manifest_pending:{path.name}")
         manifest = {
             "schema": _SCHEMA,
             "generation": gen,
@@ -290,23 +311,61 @@ class WorkflowCheckpointer:
             if got is None:
                 continue
             manifest, state = got
-            recorded = manifest.get("config_sha")
-            if (
-                expected is not None
-                and recorded is not None
-                and recorded != expected
-                and not allow_config_mismatch
-            ):
-                raise CheckpointConfigError(
-                    f"checkpoint {path.name} was written under a different "
-                    f"run config (snapshot config_sha {recorded[:12]}… != "
-                    f"expected {expected[:12]}…): algorithm, population "
-                    "size, or monitor set changed. Rebuild the matching "
-                    "workflow, point at the right directory, or pass "
-                    "allow_config_mismatch=True to restore anyway."
-                )
+            self._check_config(
+                manifest, expected, path, allow_config_mismatch
+            )
             return state
         return None
+
+    @staticmethod
+    def _check_config(
+        manifest: dict,
+        expected: Optional[str],
+        path: Path,
+        allow_config_mismatch: bool,
+    ) -> None:
+        recorded = manifest.get("config_sha")
+        if (
+            expected is not None
+            and recorded is not None
+            and recorded != expected
+            and not allow_config_mismatch
+        ):
+            raise CheckpointConfigError(
+                f"checkpoint {path.name} was written under a different "
+                f"run config (snapshot config_sha {recorded[:12]}… != "
+                f"expected {expected[:12]}…): algorithm, population "
+                "size, or monitor set changed. Rebuild the matching "
+                "workflow, point at the right directory, or pass "
+                "allow_config_mismatch=True to restore anyway."
+            )
+
+    def load(
+        self,
+        generation: int,
+        expect_like: Any = None,
+        allow_config_mismatch: bool = False,
+    ) -> Optional[Any]:
+        """Restore the snapshot of ONE specific generation, or None when
+        it is absent/uncommitted/torn (same validation + config guard as
+        :meth:`latest`). The serving journal's recovery path uses this:
+        a ``chunk_complete`` barrier names its snapshot generation, and a
+        barrier whose snapshot never landed (driver killed
+        mid-background-fsync) must fall back to the previous barrier
+        rather than silently restoring a newer-but-unrelated snapshot."""
+        path = self.directory / f"ckpt_{int(generation):08d}.pkl"
+        if not self._manifest_path(path).exists():
+            return None
+        got = self._load_validated(path)
+        if got is None:
+            return None
+        manifest, state = got
+        expected = (
+            None if expect_like is None
+            else state_config_fingerprint(expect_like)
+        )
+        self._check_config(manifest, expected, path, allow_config_mismatch)
+        return state
 
     def _manifest_path(self, path: Path) -> Path:
         return path.with_suffix(".pkl.manifest.json")

@@ -1,0 +1,279 @@
+"""Durable serving journal: a hash-chained write-ahead log for RunQueue.
+
+PR 2 healed the evaluation farm, PR 3 the algorithm numerics, PR 5 the
+dispatch layer — but the SERVING layer (``RunQueue`` over a
+``VectorizedWorkflow``, PR 7/8) kept its entire sweep bookkeeping
+(pending specs, slot assignments, budgets, results) in Python memory: a
+SIGKILL'd driver lost everything the fleet had not individually
+checkpointed. Fiber (PAPERS.md) treats member failure and re-admission
+as NORMAL scheduling events; this module gives the queue the durable
+ledger that makes driver death one too.
+
+:class:`RunJournal` is an append-only JSON-lines file where every queue
+transition (``submit`` / ``start`` / ``admit`` / ``chunk_complete`` /
+``retire`` / ``evict`` / ``health`` / ``recover``) is one fsynced
+record. Records are **hash-chained**: each carries ``prev`` (the SHA-256
+of the previous record's canonical serialization) and ``sha`` (its own),
+so the journal is tamper-evident end to end — a modified or deleted
+MIDDLE record breaks the chain of everything after it and raises
+:class:`JournalIntegrityError` loudly, while a torn TAIL (the one
+partial line a crash mid-append can leave, given per-record fsync) is
+skipped with a warning and physically truncated so later appends keep
+the file well-formed — the same corrupt-skip discipline as
+``WorkflowCheckpointer.latest()``.
+
+Crash-consistency contract (tests/test_serving_chaos.py): the journal is
+written BEFORE (submits, close-outs) or AT (chunk barriers) the
+transitions it describes, and every ``chunk_complete`` record embeds the
+queue's full host-side bookkeeping (pending spec seqs, slot table,
+counters, results length) next to the fleet-snapshot path it refers to.
+``RunQueue.recover`` therefore rebuilds the exact queue from the newest
+barrier whose snapshot is intact and REPLAYS the lost stretch
+deterministically — no spec lost, none admitted twice, per-tenant
+results and telemetry fingerprints identical to the uncrashed run.
+Everything here is host-side file I/O between dispatches — no callbacks,
+axon-safe (pinned by tests/test_no_host_callbacks.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["RunJournal", "JournalIntegrityError"]
+
+_SCHEMA = "evox_tpu.run_journal/v1"
+_GENESIS = "0" * 64
+
+# every queue transition the journal records; append() rejects anything
+# else so a typo'd kind cannot silently create an event class the
+# recovery replay and the run_report validator do not know about
+EVENT_KINDS = (
+    "submit",
+    "start",
+    "admit",
+    "chunk_complete",
+    "retire",
+    "evict",
+    "freeze",
+    "health",
+    "recover",
+)
+
+
+class JournalIntegrityError(RuntimeError):
+    """The journal's hash chain is broken somewhere BEFORE its tail — a
+    middle record was edited, replaced, or deleted. Unlike a torn tail
+    (the expected crash artifact, skipped with a warning), a broken
+    middle means the ledger can no longer be trusted as a whole, so the
+    error is loud instead of self-healing."""
+
+
+def jsonable(obj: Any) -> Any:
+    """Coerce numpy/jax scalars and arrays into plain JSON types so
+    journal payloads (hyperparams, health signals) serialize without a
+    custom encoder. Non-finite floats become None (the
+    ``sanitize_json`` rule — the journal is strict RFC 8259 JSON)."""
+    if isinstance(obj, dict):
+        return {str(k): jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonable(v) for v in obj]
+    if isinstance(obj, (bool, np.bool_)):
+        return bool(obj)
+    if isinstance(obj, (int, np.integer)):
+        return int(obj)
+    if isinstance(obj, (float, np.floating)):
+        f = float(obj)
+        return f if np.isfinite(f) else None
+    if hasattr(obj, "tolist"):  # numpy / jax arrays
+        return jsonable(np.asarray(obj).tolist())
+    return obj
+
+
+def _canonical(record: Dict[str, Any]) -> bytes:
+    """The byte string the record's ``sha`` commits to: the record
+    without its own ``sha`` field, serialized with sorted keys and no
+    whitespace — independent of dict insertion order."""
+    body = {k: v for k, v in record.items() if k != "sha"}
+    return json.dumps(
+        body, sort_keys=True, separators=(",", ":"), allow_nan=False
+    ).encode()
+
+
+class RunJournal:
+    """Append-only, fsynced, hash-chained JSON-lines event log.
+
+    Args:
+        directory: journal directory (created if missing). An existing
+            ``journal.jsonl`` is ADOPTED: the chain is verified, a torn
+            tail is truncated with a warning, and appends continue the
+            chain — that is the crash-recovery path.
+
+    Thread safety: ``append`` takes an internal lock, so the queue's
+    caller thread and the executor's background lanes may interleave
+    appends; each record is written and fsynced atomically under the
+    lock, so the chain stays valid in submission order.
+    """
+
+    FILENAME = "journal.jsonl"
+
+    def __init__(self, directory: str):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.path = self.directory / self.FILENAME
+        self._lock = threading.Lock()
+        self.torn_tail_dropped = 0
+        self._records: List[Dict[str, Any]] = []
+        self._last_sha = _GENESIS
+        if self.path.exists():
+            self._adopt()
+
+    # ------------------------------------------------------------------ read
+    def _adopt(self) -> None:
+        """Verify the existing file's chain; truncate a torn tail (the
+        only damage a single-writer fsync-per-record log can suffer from
+        a crash) and raise on anything deeper."""
+        raw = self.path.read_bytes()
+        lines = raw.split(b"\n")
+        # byte offset where each line starts, for physical truncation
+        offsets, pos = [], 0
+        for line in lines:
+            offsets.append(pos)
+            pos += len(line) + 1
+        records: List[Dict[str, Any]] = []
+        last_sha = _GENESIS
+        bad_index: Optional[int] = None
+        bad_reason = ""
+        chain_break = False
+        nonempty = [i for i, ln in enumerate(lines) if ln.strip()]
+        for i in nonempty:
+            line = lines[i]
+            try:
+                record = json.loads(line)
+                if not isinstance(record, dict):
+                    raise ValueError("record is not a JSON object")
+                sha = hashlib.sha256(_canonical(record)).hexdigest()
+                if record.get("sha") != sha:
+                    raise ValueError(
+                        f"sha {str(record.get('sha'))[:12]}… does not match "
+                        f"recomputed {sha[:12]}…"
+                    )
+                if record.get("prev") != last_sha:
+                    # a torn append can never COMPLETE a record (the sha
+                    # field closes the line), so a self-consistent record
+                    # whose prev doesn't chain means a predecessor was
+                    # edited or deleted — tamper, wherever it sits
+                    chain_break = True
+                    raise ValueError(
+                        f"prev {str(record.get('prev'))[:12]}… does not "
+                        f"chain from {last_sha[:12]}…"
+                    )
+            except ValueError as e:
+                bad_index = i
+                bad_reason = str(e)
+                break
+            records.append(record)
+            last_sha = record["sha"]
+        if bad_index is not None:
+            if chain_break or bad_index != nonempty[-1]:
+                # valid-looking records FOLLOW the bad one: a torn append
+                # cannot produce that (each record is fsynced before the
+                # next is written) — the middle of the ledger was changed
+                raise JournalIntegrityError(
+                    f"journal {self.path} record {len(records)} is invalid "
+                    f"({bad_reason}) but later records exist — the chain "
+                    "was tampered with mid-file; refusing to adopt. "
+                    "Restore the journal from a copy or start a fresh "
+                    "directory."
+                )
+            warnings.warn(
+                f"journal {self.path}: dropping torn tail record "
+                f"{len(records)} ({bad_reason}) — the expected artifact of "
+                "a crash mid-append",
+                stacklevel=2,
+            )
+            self.torn_tail_dropped += 1
+            with open(self.path, "r+b") as f:
+                f.truncate(offsets[bad_index])
+                f.flush()
+                os.fsync(f.fileno())
+        self._records = records
+        self._last_sha = last_sha
+
+    def records(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        """All adopted+appended records (a copy), optionally filtered."""
+        with self._lock:
+            recs = list(self._records)
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        return recs
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.records():
+            out[r["kind"]] = out.get(r["kind"], 0) + 1
+        return out
+
+    @staticmethod
+    def verify(directory: str) -> int:
+        """Re-read a journal from disk, raising
+        :class:`JournalIntegrityError` on a broken chain; returns the
+        number of intact records. (Adoption already verifies — this is
+        the standalone audit entry point.)"""
+        return len(RunJournal(directory).records())
+
+    # ----------------------------------------------------------------- write
+    def append(self, kind: str, **payload: Any) -> Dict[str, Any]:
+        """Append one event record and fsync it before returning — the
+        WAL guarantee: once ``append`` returns, the transition is
+        durable. ``payload`` values are coerced to strict JSON."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown journal event kind {kind!r}; expected one of "
+                f"{EVENT_KINDS}"
+            )
+        with self._lock:
+            record: Dict[str, Any] = {
+                "schema": _SCHEMA,
+                "seq": len(self._records),
+                "kind": kind,
+                "t": round(time.time(), 6),
+                "prev": self._last_sha,
+            }
+            record.update(jsonable(payload))
+            record["sha"] = hashlib.sha256(_canonical(record)).hexdigest()
+            line = json.dumps(
+                record, sort_keys=True, separators=(",", ":"),
+                allow_nan=False,
+            )
+            with open(self.path, "ab") as f:
+                f.write(line.encode() + b"\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._records.append(record)
+            self._last_sha = record["sha"]
+            return record
+
+    # ---------------------------------------------------------------- report
+    def report(self) -> dict:
+        """The ``tenancy.queue.journal`` section of ``run_report()``
+        (schema v6, validated by tools/check_report.py): per-kind event
+        counters, the chain head, and whether this journal has ever been
+        recovered from."""
+        counts = self.counts()
+        return {
+            "path": str(self.path),
+            "records": len(self._records),
+            "last_seq": len(self._records) - 1,
+            "events": counts,
+            "recovered": counts.get("recover", 0) > 0,
+            "torn_tail_dropped": self.torn_tail_dropped,
+        }

@@ -1,0 +1,173 @@
+"""Process-level chaos harness: SIGKILL a sweep driver, recover it.
+
+tests/_chaos.py injects faults INSIDE a live process (dead workers,
+poisoned state, flaky dispatches); this module injects the one fault no
+in-process harness can fake honestly — the DRIVER itself dying. A child
+process runs a journaled ``RunQueue`` sweep (the canonical 4-slot fleet,
+12 specs, varying budgets) and SIGKILLs itself at a scripted moment:
+
+- ``kill_after_chunks=K`` — immediately after chunk ``K``'s barrier
+  (``step_chunk`` returned), i.e. at a chunk boundary. Whether that
+  barrier's background fleet snapshot had landed is a genuine race the
+  recovery path must (and does) handle either way.
+- ``kill_fsync=(point_prefix, nth)`` — inside the
+  ``workflows/checkpoint.py`` durable-write path, on the executor's
+  BACKGROUND checkpoint lane only (thread-name gated), at the nth write
+  reaching the named crash point: ``"manifest_pending"`` kills between
+  a snapshot's committed data file and its manifest (the torn-snapshot
+  shape), ``"pre_rename"`` kills before the atomic replace (the
+  torn-tmp shape). This is the power-loss barrier test for the
+  background lane.
+
+The parent then calls ``RunQueue.recover(fresh_workflow, journal_dir)``
+and drives the sweep to completion; tests/test_serving_chaos.py asserts
+the recovered per-tenant results (tags, statuses, generations,
+TelemetryMonitor fingerprints) equal the uncrashed reference run's —
+the crash-equivalence law. Everything is deterministic: the kill points
+are scripted, the replay is pure state + journal.
+
+Children are spawned (not forked): each gets a fresh jax runtime with
+the same env (conftest exports JAX_PLATFORMS/XLA_FLAGS before any
+spawn), so child and parent compile identical programs and the
+bit-identity assertions are meaningful across the process boundary —
+the same property the multiprocess farm tests already rely on.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+import sys
+from typing import List, Optional, Tuple
+
+N_TENANTS = 4
+DIM, POP = 4, 8
+CHUNK = 3
+BUDGETS = [5, 6, 7, 8] * 3  # 12 specs through a 4-wide fleet
+
+
+def build_workflow():
+    import jax.numpy as jnp
+
+    from evox_tpu import VectorizedWorkflow
+    from evox_tpu.algorithms.so.es import CMAES
+    from evox_tpu.monitors import TelemetryMonitor
+    from evox_tpu.problems.numerical import Sphere
+
+    algo = CMAES(center_init=jnp.ones(DIM), init_stdev=1.0, pop_size=POP)
+    return VectorizedWorkflow(
+        algo,
+        Sphere(),
+        n_tenants=N_TENANTS,
+        monitors=(TelemetryMonitor(capacity=8),),
+    )
+
+
+def build_queue(journal_dir, workflow=None, health_policy=None):
+    from evox_tpu import RunQueue
+
+    return RunQueue(
+        workflow if workflow is not None else build_workflow(),
+        chunk=CHUNK,
+        journal=str(journal_dir),
+        health_policy=health_policy,
+    )
+
+
+def submit_all(q) -> None:
+    from evox_tpu import TenantSpec
+
+    for i, budget in enumerate(BUDGETS):
+        q.submit(TenantSpec(seed=i, n_steps=budget, tag=f"job{i:02d}"))
+
+
+def result_digest(results: List[dict]) -> List[tuple]:
+    """The comparison key of the crash-equivalence law: per-tenant tag,
+    status, generations run, and the telemetry ring fingerprint (bit
+    identity of the whole observed trajectory)."""
+    return [
+        (
+            r["tag"],
+            r["status"],
+            r["generations"],
+            tuple(r.get("fingerprints") or ()),
+        )
+        for r in results
+    ]
+
+
+def _install_fsync_kill(point_prefix: str, nth: int) -> None:
+    """Arm the checkpoint-layer crash hook to SIGKILL this process the
+    ``nth`` time the named durable-write point is reached ON the
+    executor's background fleet-snapshot lane (other writers — tenant
+    close-out snapshots, journal config files — are ignored, so the kill
+    lands mid-BACKGROUND-fsync by construction)."""
+    import threading
+
+    from evox_tpu.workflows import checkpoint as _ckpt
+
+    seen = {"n": 0}
+
+    def hook(point: str) -> None:
+        if not point.startswith(point_prefix):
+            return
+        if not threading.current_thread().name.startswith(
+            "executor-fleet_snapshot"
+        ):
+            return
+        seen["n"] += 1
+        if seen["n"] >= nth:
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    _ckpt._CRASH_HOOK = hook
+
+
+def driver_main(
+    journal_dir: str,
+    kill_after_chunks: Optional[int] = None,
+    kill_fsync: Optional[Tuple[str, int]] = None,
+) -> None:
+    """Child entry point: run the canonical sweep, die on schedule.
+    Exits 0 on clean completion with no kill configured, 7 when a
+    configured kill never fired (the parent treats that as a harness
+    bug, not a pass)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if kill_fsync is not None:
+        _install_fsync_kill(*kill_fsync)
+    q = build_queue(journal_dir)
+    submit_all(q)
+    q.start()
+    while True:
+        more = q.step_chunk()
+        if (
+            kill_after_chunks is not None
+            and q.counters["chunks"] >= kill_after_chunks
+        ):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if not more:
+            break
+    sys.exit(0 if kill_after_chunks is None and kill_fsync is None else 7)
+
+
+def run_driver(
+    journal_dir,
+    kill_after_chunks: Optional[int] = None,
+    kill_fsync: Optional[Tuple[str, int]] = None,
+    timeout: float = 600.0,
+) -> int:
+    """Spawn the driver child; returns its exit code (-SIGKILL when the
+    scripted kill fired)."""
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(
+        target=driver_main,
+        args=(str(journal_dir), kill_after_chunks, kill_fsync),
+        daemon=True,
+    )
+    p.start()
+    p.join(timeout)
+    if p.is_alive():
+        p.kill()
+        p.join()
+        raise RuntimeError("chaos driver child hung past its timeout")
+    return p.exitcode

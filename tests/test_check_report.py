@@ -178,3 +178,128 @@ def test_validator_cli_detects_jsonl(tmp_path):
     ok = tmp_path / "ok.jsonl"
     ok.write_text(json.dumps(good) + "\n")
     assert check_report.main([str(ok)]) == 0
+
+
+def _serving_tenancy():
+    """A well-formed v6 serving tenancy section: journaled queue with
+    its WAL counters plus a fleet_health action log — the shape
+    RunQueue.report()/health_report() emit after a journaled sweep."""
+    return {
+        "n_tenants": 2,
+        "leading_axes": [2],
+        "per_tenant": [{"tenant": 0}, {"tenant": 1}],
+        "queue": {
+            "capacity": 2,
+            "chunk": 3,
+            "counters": {
+                "submitted": 3,
+                "admitted": 3,
+                "retired": 2,
+                "evicted": 1,
+            },
+            "results": [
+                {"tag": "a", "status": "completed", "generations": 5},
+                {
+                    "tag": "b",
+                    "status": "evicted",
+                    "generations": 3,
+                    "checkpoint": "/tmp/ckpts/b",
+                },
+            ],
+            "journal": {
+                "path": "/tmp/journal/journal.jsonl",
+                "records": 11,
+                "last_seq": 10,
+                "events": {
+                    "submit": 3,
+                    "start": 1,
+                    "admit": 3,
+                    "chunk_complete": 2,
+                    "retire": 1,
+                    "evict": 1,
+                },
+                "recovered": False,
+                "torn_tail_dropped": 0,
+            },
+        },
+        "fleet_health": {
+            "policy": {
+                "on_nonfinite": "evict",
+                "on_trigger": None,
+                "stagnation_limit": None,
+                "on_stagnation": "restart",
+                "max_restarts_per_slot": 2,
+            },
+            "events": [
+                {
+                    "health_seq": 0,
+                    "chunk": 1,
+                    "slot": 1,
+                    "tag": "b",
+                    "action": "evict",
+                    "reason": "nonfinite_state",
+                    "generation": 3,
+                }
+            ],
+        },
+    }
+
+
+def test_validator_v6_serving_sections_pass():
+    report = _fresh_report(False)
+    report["tenancy"] = _serving_tenancy()
+    assert check_report.validate_run_report(report) == []
+
+
+def test_validator_v6_journal_rules():
+    """The WAL counters must be known kinds summing to the ledger total
+    (monotonicity), and the recovered flag must agree with the recover
+    event count."""
+    report = _fresh_report(False)
+    report["tenancy"] = _serving_tenancy()
+    journal = report["tenancy"]["queue"]["journal"]
+    journal["events"]["reticulate"] = 1
+    journal["events"]["submit"] = 5  # sum 14 != records 11
+    journal["recovered"] = True  # but no recover event
+    journal["last_seq"] = 3  # != records - 1
+    errors = "\n".join(check_report.validate_run_report(report))
+    assert "unknown kind 'reticulate'" in errors
+    assert "not monotonic with the ledger" in errors
+    assert "incoherent with its recover event count" in errors
+    assert "last_seq" in errors
+
+
+def test_validator_v6_fleet_health_rules():
+    """Every health event must name a real slot and a known action, in
+    chunk order."""
+    report = _fresh_report(False)
+    report["tenancy"] = _serving_tenancy()
+    events = report["tenancy"]["fleet_health"]["events"]
+    events.append(
+        {
+            "health_seq": 1,
+            "chunk": 0,  # decreasing vs the seeded chunk-1 event
+            "slot": 7,  # out of range for n_tenants=2
+            "action": "defenestrate",
+            "reason": "because",
+            "generation": 4,
+        }
+    )
+    errors = "\n".join(check_report.validate_run_report(report))
+    assert "events[1].action" in errors
+    assert "events[1].slot" in errors
+    assert "chunk not non-decreasing" in errors
+
+
+def test_validator_v6_journaled_evict_needs_checkpoint():
+    """A journaled eviction's whole point is the resumable artifact: an
+    evicted/frozen result without a checkpoint path is rejected — but
+    only under a journal (plain queues may run checkpoint-less)."""
+    report = _fresh_report(False)
+    report["tenancy"] = _serving_tenancy()
+    del report["tenancy"]["queue"]["results"][1]["checkpoint"]
+    errors = "\n".join(check_report.validate_run_report(report))
+    assert "names no checkpoint path" in errors
+    # checkpoint-less evictions are fine on an unjournaled queue
+    del report["tenancy"]["queue"]["journal"]
+    assert check_report.validate_run_report(report) == []

@@ -232,3 +232,16 @@ def test_supervisor_module_is_callback_free():
     for rel in ("workflows/supervisor.py", "workflows/checkpoint.py"):
         assert (PKG / rel).exists(), f"{rel} missing"
         assert rel not in users, f"{rel} must not use host callbacks"
+
+
+def test_serving_fault_domain_modules_are_callback_free():
+    """The ISSUE-11 serving fault domains must hold the axon constraint
+    by construction: the journal is pure host file I/O between
+    dispatches (fsynced JSON-lines appends), and the fleet health layer
+    is one jitted signal computation plus host-side policy decisions at
+    chunk boundaries — a host callback in either would make durable
+    serving unusable on the tunneled TPU it exists to keep alive."""
+    users = _scan()
+    for rel in ("workflows/journal.py", "workflows/fleet_health.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"

@@ -1,0 +1,478 @@
+"""Serving fault domains (ISSUE 11): durable journal, driver-kill
+recovery, and per-tenant fault isolation.
+
+Laws under test:
+
+- **Crash equivalence**: SIGKILL the sweep driver at chunk boundaries
+  (and mid-background-fsync) → ``RunQueue.recover()`` completes the
+  sweep with per-tenant results and TelemetryMonitor fingerprints
+  identical to the uncrashed run; every spec admitted exactly once.
+  The kill really is a process death (tests/_proc_chaos.py children),
+  not an in-process simulation.
+- **Isolation**: with one tenant NaN-poisoned
+  (tests/_chaos.py::poison_algo_field through the tenant-surgery
+  round-trip), the fleet completes, healthy tenants' trajectories are
+  bitwise-equal (telemetry ring fingerprints) to the no-poison run, and
+  the poisoned tenant's freeze/evict/restart verdict appears in
+  ``run_report()["tenancy"]["fleet_health"]`` — for all three actions.
+- **Durability mechanics**: the hash-chained journal rejects a tampered
+  middle record loudly (JournalIntegrityError), skips+truncates a torn
+  tail with a warning, and ``recover()`` on a journal whose config
+  fingerprint mismatches the supplied workflow raises
+  CheckpointConfigError (the PR-5 guard, reused).
+- **Background-lane crash barrier** (WorkflowCheckpointer satellite): a
+  kill DURING the executor background lane's snapshot fsync leaves
+  ``latest()`` returning the previous intact snapshot.
+"""
+
+import json
+import shutil
+import signal
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu import (
+    CheckpointConfigError,
+    FleetHealthPolicy,
+    JournalIntegrityError,
+    RunJournal,
+    RunQueue,
+    TenantSpec,
+    VectorizedWorkflow,
+    WorkflowCheckpointer,
+    run_report,
+)
+from evox_tpu.algorithms.so.es import CMAES
+from evox_tpu.monitors import TelemetryMonitor
+from evox_tpu.problems.numerical import Sphere
+from tests import _proc_chaos as pc
+from tests._chaos import poison_algo_field
+
+try:
+    import sys
+
+    sys.path.insert(0, "tools")
+    import check_report
+finally:
+    pass
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_journal_chain_roundtrip(tmp_path):
+    j = RunJournal(str(tmp_path))
+    j.append("submit", spec_seq=0, n_steps=5, tag="a", hyperparams={})
+    j.append("start", config_sha="x" * 64, n_tenants=2, chunk=3)
+    j.append("chunk_complete", generation=3, results_len=0)
+    # a fresh reader adopts and verifies the chain
+    j2 = RunJournal(str(tmp_path))
+    recs = j2.records()
+    assert [r["kind"] for r in recs] == ["submit", "start", "chunk_complete"]
+    assert recs[1]["prev"] == recs[0]["sha"]
+    assert RunJournal.verify(str(tmp_path)) == 3
+    rep = j2.report()
+    assert rep["records"] == 3 and rep["recovered"] is False
+    # appends continue the adopted chain
+    j2.append("recover", generation=3)
+    assert RunJournal.verify(str(tmp_path)) == 4
+
+
+def test_journal_rejects_unknown_kind(tmp_path):
+    j = RunJournal(str(tmp_path))
+    with pytest.raises(ValueError, match="unknown journal event kind"):
+        j.append("reticulate", foo=1)
+
+
+def test_journal_torn_tail_truncated(tmp_path):
+    j = RunJournal(str(tmp_path))
+    for i in range(3):
+        j.append("submit", spec_seq=i, n_steps=5, hyperparams={})
+    # tear the tail mid-record: the crash artifact per-record fsync allows
+    raw = j.path.read_bytes()
+    j.path.write_bytes(raw[: len(raw) - 20])
+    with pytest.warns(UserWarning, match="torn tail"):
+        j2 = RunJournal(str(tmp_path))
+    assert len(j2.records()) == 2
+    assert j2.torn_tail_dropped == 1
+    # the file was physically repaired, so the chain stays appendable
+    j2.append("submit", spec_seq=2, n_steps=5, hyperparams={})
+    assert RunJournal.verify(str(tmp_path)) == 3
+
+
+def test_journal_tampered_middle_raises(tmp_path):
+    j = RunJournal(str(tmp_path))
+    for i in range(3):
+        j.append("submit", spec_seq=i, n_steps=5 + i, hyperparams={})
+    lines = j.path.read_text().splitlines()
+    middle = json.loads(lines[1])
+    middle["n_steps"] = 999  # rewrite history without fixing the sha
+    lines[1] = json.dumps(middle, sort_keys=True, separators=(",", ":"))
+    j.path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalIntegrityError, match="tampered"):
+        RunJournal(str(tmp_path))
+
+
+def test_journal_deleted_middle_raises(tmp_path):
+    j = RunJournal(str(tmp_path))
+    for i in range(3):
+        j.append("submit", spec_seq=i, n_steps=5, hyperparams={})
+    lines = j.path.read_text().splitlines()
+    del lines[1]  # the chain's prev pointers expose the deletion
+    j.path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalIntegrityError):
+        RunJournal(str(tmp_path))
+
+
+# --------------------------------------------------------- crash equivalence
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """The uncrashed 12-spec sweep, run in-process: the digest every
+    recovered run must reproduce, plus the sweep's chunk count."""
+    ref_dir = tmp_path_factory.mktemp("ref_journal")
+    q = pc.build_queue(ref_dir)
+    pc.submit_all(q)
+    results = q.run()
+    n_chunks = q.counters["chunks"]
+    assert len(results) == len(pc.BUDGETS)
+    return {
+        "dir": ref_dir,
+        "digest": pc.result_digest(results),
+        "n_chunks": n_chunks,
+    }
+
+
+def _recover_and_finish(journal_dir):
+    q = RunQueue.recover(pc.build_workflow(), str(journal_dir))
+    q.run()
+    return q
+
+
+def _assert_crash_equivalent(journal_dir, reference):
+    q = _recover_and_finish(journal_dir)
+    digest = pc.result_digest(q.results)
+    assert digest == reference["digest"]
+    # no spec lost, none run twice
+    assert q.counters["admitted"] == len(pc.BUDGETS)
+    tags = [d[0] for d in digest]
+    assert sorted(tags) == sorted(set(tags))
+    rep = run_report(q.workflow, q.state)
+    journal = rep["tenancy"]["queue"]["journal"]
+    assert journal["recovered"] is True
+    assert check_report.validate_run_report(rep) == []
+    return q
+
+
+@pytest.mark.proc_chaos
+@pytest.mark.parametrize("kill_at", [2, 5])
+def test_driver_sigkill_at_chunk_boundary(tmp_path, reference, kill_at):
+    """Tier-1 smoke of the crash law: the driver is SIGKILL'd right
+    after chunk ``kill_at``'s barrier; recovery completes the sweep
+    with identical per-tenant results and fingerprints."""
+    jd = tmp_path / f"kill{kill_at}"
+    code = pc.run_driver(jd, kill_after_chunks=kill_at)
+    assert code == -signal.SIGKILL
+    _assert_crash_equivalent(jd, reference)
+
+
+@pytest.mark.proc_chaos
+@pytest.mark.slow
+def test_driver_sigkill_full_matrix(tmp_path, reference):
+    """The full acceptance sweep: a SIGKILL at EVERY chunk boundary of
+    the 12-spec sweep recovers to the identical result set."""
+    for k in range(1, reference["n_chunks"] + 1):
+        if k in (2, 5):
+            continue  # tier-1 smoke already covers these boundaries
+        jd = tmp_path / f"kill{k}"
+        code = pc.run_driver(jd, kill_after_chunks=k)
+        assert code == -signal.SIGKILL, f"kill at chunk {k} never fired"
+        _assert_crash_equivalent(jd, reference)
+
+
+@pytest.mark.proc_chaos
+def test_driver_sigkill_mid_background_fsync(tmp_path, reference):
+    """Kill DURING the executor background lane's snapshot commit (data
+    durable, manifest not): the power-loss barrier must leave latest()
+    on the previous intact snapshot, and recovery must fall back one
+    barrier and still reproduce the uncrashed results."""
+    jd = tmp_path / "fsync_kill"
+    code = pc.run_driver(jd, kill_fsync=("manifest_pending", 2))
+    assert code == -signal.SIGKILL
+    fleet = WorkflowCheckpointer(str(jd / "fleet"))
+    snap = fleet.latest()
+    assert snap is not None  # the previous snapshot is intact
+    # the torn artifact is really there: a committed data file with no
+    # manifest (the exact shape latest() must skip)
+    torn = [
+        p
+        for p in (jd / "fleet").glob("ckpt_????????.pkl")
+        if not p.with_suffix(".pkl.manifest.json").exists()
+    ]
+    assert torn, "the kill did not land mid-commit"
+    assert int(snap.generation) < max(
+        int(p.name[5:13]) for p in torn
+    )
+    _assert_crash_equivalent(jd, reference)
+
+
+@pytest.mark.proc_chaos
+@pytest.mark.slow
+def test_driver_sigkill_pre_rename_fsync(tmp_path, reference):
+    """The other torn-write shape: killed before the atomic replace —
+    only a tmp file leaks; latest() never sees it. (The pre_rename
+    point fires for data AND manifest renames: match 3 is the SECOND
+    snapshot's data rename, so snapshot 1 is fully committed.)"""
+    jd = tmp_path / "rename_kill"
+    code = pc.run_driver(jd, kill_fsync=("pre_rename:ckpt_", 3))
+    assert code == -signal.SIGKILL
+    assert WorkflowCheckpointer(str(jd / "fleet")).latest() is not None
+    _assert_crash_equivalent(jd, reference)
+
+
+def test_recover_config_mismatch_raises(reference):
+    """The PR-5 config guard, reused at the journal layer: a workflow
+    whose fleet structure differs from the journaled one is refused."""
+    wrong_algo = CMAES(
+        center_init=jnp.ones(pc.DIM), init_stdev=1.0,
+        pop_size=pc.POP * 2,  # different population -> different shapes
+    )
+    wrong = VectorizedWorkflow(
+        wrong_algo, Sphere(), n_tenants=pc.N_TENANTS,
+        monitors=(TelemetryMonitor(capacity=8),),
+    )
+    with pytest.raises(CheckpointConfigError):
+        RunQueue.recover(wrong, str(reference["dir"]))
+
+
+def test_recover_tampered_journal_raises(tmp_path, reference):
+    jd = tmp_path / "tampered"
+    shutil.copytree(reference["dir"], jd)
+    path = jd / RunJournal.FILENAME
+    lines = path.read_text().splitlines()
+    mid = json.loads(lines[len(lines) // 2])
+    mid["kind"] = "recover"
+    lines[len(lines) // 2] = json.dumps(
+        mid, sort_keys=True, separators=(",", ":")
+    )
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalIntegrityError):
+        RunQueue.recover(pc.build_workflow(), str(jd))
+
+
+def test_recover_rebuilds_health_policy(tmp_path):
+    """The policy CONFIG is part of the sweep: recover() without an
+    explicit health_policy= rebuilds the journaled one, so a tenant that
+    goes non-finite AFTER the restored barrier is still isolated in the
+    replay — exactly as the uncrashed run would have done."""
+    jd = tmp_path / "policy"
+    wf = pc.build_workflow()
+    q = pc.build_queue(
+        jd, workflow=wf,
+        health_policy=FleetHealthPolicy(on_nonfinite="evict"),
+    )
+    pc.submit_all(q)
+    q.start()
+    q.step_chunk()
+    q.executor.drain_lane("fleet_snapshot")  # barrier snapshot durable
+    del q  # "crash" between chunks
+    q2 = RunQueue.recover(pc.build_workflow(), str(jd))
+    assert isinstance(q2.health_policy, FleetHealthPolicy)
+    assert q2.health_policy.on_nonfinite == "evict"
+    # the rebuilt policy actually acts: poison a tenant mid-replay
+    # (slot 3's budget outlives the next chunk boundary, where the
+    # policy fires — a shorter-budget slot would retire first)
+    solo = q2.workflow.extract_tenant(q2.state, 3)
+    solo = poison_algo_field(solo, "mean", float("nan"))
+    q2.state = q2.workflow.insert_tenant(q2.state, 3, solo)
+    q2.run()
+    assert any(
+        e["action"] == "evict" and e["reason"] == "nonfinite_state"
+        for e in q2.health_events
+    )
+
+
+def test_recover_roundtrips_typed_key_seeds(tmp_path):
+    """A TenantSpec seeded with a TYPED PRNG key must recover as a
+    typed key of the same impl (raw key data would change the fleet's
+    key-leaf dtypes — spurious config mismatch, broken admission)."""
+    jd = tmp_path / "typed"
+    wf = VectorizedWorkflow(
+        CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0, pop_size=pc.POP),
+        Sphere(),
+        n_tenants=2,
+    )
+    q = RunQueue(wf, chunk=3, journal=str(jd))
+    for i in range(3):
+        q.submit(
+            TenantSpec(seed=jax.random.key(i), n_steps=4, tag=f"k{i}")
+        )
+    del q
+    wf2 = VectorizedWorkflow(
+        CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0, pop_size=pc.POP),
+        Sphere(),
+        n_tenants=2,
+    )
+    q2 = RunQueue.recover(wf2, str(jd))
+    rebuilt = q2.pending[0].key()
+    assert jnp.issubdtype(rebuilt.dtype, jax.dtypes.prng_key)
+    assert (
+        jax.random.key_data(rebuilt) == jax.random.key_data(jax.random.key(0))
+    ).all()
+    results = q2.run()
+    assert sorted(r["tag"] for r in results) == ["k0", "k1", "k2"]
+
+
+def test_recover_before_start(tmp_path):
+    """Killed between submits and start(): every acknowledged spec is
+    durable and the recovered queue runs the whole (small) sweep."""
+    jd = tmp_path / "prestart"
+    wf = VectorizedWorkflow(
+        CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0, pop_size=pc.POP),
+        Sphere(),
+        n_tenants=2,
+    )
+    q = RunQueue(wf, chunk=3, journal=str(jd))
+    for i in range(3):
+        q.submit(TenantSpec(seed=i, n_steps=4, tag=f"p{i}"))
+    del q  # "crash": the queue object is simply gone
+    wf2 = VectorizedWorkflow(
+        CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0, pop_size=pc.POP),
+        Sphere(),
+        n_tenants=2,
+    )
+    q2 = RunQueue.recover(wf2, str(jd))
+    results = q2.run()
+    assert sorted(r["tag"] for r in results) == ["p0", "p1", "p2"]
+    assert all(r["generations"] == 4 for r in results)
+
+
+# ------------------------------------------------------------ isolation law
+
+ISO_BUDGET = 9
+
+
+def _iso_sweep(tmp_path, action, poison_slot=None):
+    wf = VectorizedWorkflow(
+        CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0, pop_size=pc.POP),
+        Sphere(),
+        n_tenants=pc.N_TENANTS,
+        monitors=(TelemetryMonitor(capacity=8),),
+    )
+    q = RunQueue(
+        wf,
+        chunk=3,
+        journal=str(tmp_path),
+        health_policy=FleetHealthPolicy(on_nonfinite=action),
+    )
+    for i in range(pc.N_TENANTS):
+        q.submit(TenantSpec(seed=i, n_steps=ISO_BUDGET, tag=f"t{i}"))
+    q.start()
+    q.step_chunk()
+    if poison_slot is not None:
+        # the PR-3 fault injector, through the tenant-surgery round-trip:
+        # extract the slot as a solo state, NaN its CMA mean, insert it
+        # back — only that row of the stacked fleet state changes
+        solo = wf.extract_tenant(q.state, poison_slot)
+        solo = poison_algo_field(solo, "mean", float("nan"))
+        q.state = wf.insert_tenant(q.state, poison_slot, solo)
+    while q.step_chunk():
+        pass
+    return q
+
+
+@pytest.fixture(scope="module")
+def iso_baseline(tmp_path_factory):
+    """No-poison run with the SAME policy/program shape (the freeze mask
+    is part of the compiled carry, so the baseline must carry it too)."""
+    q = _iso_sweep(tmp_path_factory.mktemp("iso_base"), "freeze")
+    return pc.result_digest(q.results)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("action", ["freeze", "evict", "restart"])
+def test_poisoned_tenant_isolated(tmp_path, iso_baseline, action):
+    """One NaN-poisoned tenant: the fleet completes, the policy's action
+    is visible in run_report, and every HEALTHY tenant's telemetry ring
+    fingerprints bitwise-equal the no-poison run's."""
+    q = _iso_sweep(tmp_path, action, poison_slot=1)
+    rep = run_report(q.workflow, q.state)
+    events = rep["tenancy"]["fleet_health"]["events"]
+    assert any(
+        e["action"] == action and e["slot"] == 1
+        and e["reason"] == "nonfinite_state"
+        for e in events
+    )
+    assert check_report.validate_run_report(rep) == []
+    digest = {d[0]: d for d in pc.result_digest(q.results)}
+    base = {d[0]: d for d in iso_baseline}
+    for tag in ("t0", "t2", "t3"):  # the healthy tenants, bitwise
+        assert digest[tag] == base[tag]
+    if action == "freeze":
+        assert digest["t1"][1] == "frozen"
+        # the quarantined slot parked with a forensic checkpoint
+        frozen_entry = next(r for r in q.results if r["tag"] == "t1")
+        assert "checkpoint" in frozen_entry
+    elif action == "evict":
+        assert digest["t1"][1] == "evicted"
+    else:  # restart-in-place cleared the poison and finished the budget
+        assert digest["t1"][1] == "completed"
+        assert digest["t1"][2] == ISO_BUDGET
+        assert q.counters["restarted"] >= 1
+
+
+# ------------------------------------------------- policy machinery units
+
+
+def test_fleet_health_signals_guarded_fleet():
+    """A guarded fleet exports the stacked wrapper counters as
+    per-tenant signals (the device-side detector's verdicts): one jitted
+    scan, one small fetch, (N,) arrays keyed guard_*."""
+    from evox_tpu import GuardedAlgorithm
+    from evox_tpu.workflows.fleet_health import fleet_health_signals
+
+    wf = VectorizedWorkflow(
+        GuardedAlgorithm(
+            CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0,
+                  pop_size=pc.POP)
+        ),
+        Sphere(),
+        n_tenants=3,
+    )
+    keys = jnp.stack([jax.random.PRNGKey(i) for i in range(3)])
+    state = wf.run(wf.init(keys), 4)
+    sig = fleet_health_signals(state)
+    for key in ("generation", "nonfinite", "guard_trigger",
+                "guard_restarts", "guard_stagnation"):
+        assert key in sig and sig[key].shape == (3,), key
+    assert not sig["nonfinite"].any()
+    assert (sig["generation"] == 4).all()
+
+
+def test_health_policy_decide_severity_and_escalation():
+    """decide(): nonfinite outranks stagnation; a restart verdict
+    escalates to freeze once the slot's in-place restarts hit the cap;
+    bad action names are rejected at construction."""
+    policy = FleetHealthPolicy(
+        on_nonfinite="restart",
+        stagnation_limit=5,
+        on_stagnation="restart",
+        max_restarts_per_slot=2,
+    )
+    healthy = {"nonfinite": False, "stagnation": 1}
+    assert policy.decide(healthy) is None
+    sick = {"nonfinite": True, "stagnation": 9}
+    assert policy.decide(sick) == ("restart", "nonfinite_state")
+    # at the cap, restart escalates to freeze (never restarts forever)
+    assert policy.decide(sick, slot_restarts=2) == (
+        "freeze", "nonfinite_state",
+    )
+    stag = {"nonfinite": False, "stagnation": 7}
+    assert policy.decide(stag) == ("restart", "stagnation:7")
+    with pytest.raises(ValueError, match="on_nonfinite"):
+        FleetHealthPolicy(on_nonfinite="defenestrate")
+    with pytest.raises(ValueError, match="max_restarts_per_slot"):
+        FleetHealthPolicy(max_restarts_per_slot=-1)

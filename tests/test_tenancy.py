@@ -545,6 +545,87 @@ def test_runqueue_requires_full_fleet():
         q.start()
 
 
+def test_runqueue_double_start_raises():
+    """A second start() would pop fresh specs and re-init the fleet over
+    the live one — refused; recovery replays through the journal, never
+    through a re-start."""
+    wf = VectorizedWorkflow(_cmaes(), Sphere(), n_tenants=2)
+    q = RunQueue(wf, chunk=3)
+    for i in range(2):
+        q.submit(TenantSpec(seed=i, n_steps=6, tag=f"d{i}"))
+    q.start()
+    with pytest.raises(RuntimeError, match="already started"):
+        q.start()
+    results = q.run()
+    assert [r["status"] for r in results] == ["completed"] * 2
+
+
+def test_runqueue_evict_edge_cases(tmp_path):
+    """The evict paths recovery must replay exactly: evict outside the
+    legal between-chunk window (before start) raises, a bogus slot index
+    raises, evict-then-backfill with an EMPTY pending queue parks the
+    slot inactive with its rows masked (never crashes, never quarantines
+    the SLOT — a late submit must still admit into it), and a parked
+    slot is not evictable twice — all without losing the surviving
+    tenant's sweep."""
+    from evox_tpu import FleetHealthPolicy
+
+    # a freeze-capable policy materializes the mask, so the parked-slot
+    # masking path is exercised (healthy tenants: no action ever fires)
+    wf = VectorizedWorkflow(_cmaes(), Sphere(), n_tenants=2)
+    q = RunQueue(
+        wf, chunk=3, checkpoint_dir=str(tmp_path),
+        health_policy=FleetHealthPolicy(on_nonfinite="freeze"),
+    )
+    for i in range(2):
+        q.submit(TenantSpec(seed=i, n_steps=12, tag=f"v{i}"))
+    with pytest.raises(RuntimeError, match="before start"):
+        q.evict(0)
+    q.start()
+    q.step_chunk()
+    with pytest.raises(ValueError, match="out of range"):
+        q.evict(5)
+    # pending is empty: the slot must park as inactive, rows masked
+    entry = q.evict(0)
+    assert entry["status"] == "evicted"
+    assert entry["generations"] == 3
+    assert os.path.isdir(entry["checkpoint"])
+    slot = q.slots[0]
+    assert slot is not None and not slot.active
+    assert not slot.frozen  # parked, NOT health-quarantined
+    assert bool(q.state.frozen[0])  # but its rows stop advancing
+    with pytest.raises(ValueError, match="no active tenant"):
+        q.evict(0)
+    # a late submit refills the parked slot (mask cleared on admission)
+    q.submit(TenantSpec(seed=9, n_steps=4, tag="late"))
+    results = q.run()
+    assert q.counters["evicted"] == 1 and q.counters["retired"] == 2
+    assert q.counters["admitted"] == 3
+    done = {r["tag"]: r for r in results}
+    assert done["v1"]["status"] == "completed"
+    assert done["v1"]["generations"] == 12
+    assert done["late"]["status"] == "completed"
+    assert done["late"]["generations"] == 4
+
+
+def test_runqueue_backref_clobber_refused():
+    """Satellite regression (ISSUE 11): constructing a second RunQueue
+    over a workflow an UNFINISHED queue is driving used to silently
+    rewire run_report's tenancy.queue pickup mid-sweep — now it raises;
+    once the first queue's sweep completes, a new queue may adopt the
+    workflow (and the report follows the adopter)."""
+    wf = VectorizedWorkflow(_cmaes(), Sphere(), n_tenants=2)
+    q = RunQueue(wf, chunk=3)
+    for i in range(2):
+        q.submit(TenantSpec(seed=i, n_steps=6, tag=f"b{i}"))
+    with pytest.raises(RuntimeError, match="already driven"):
+        RunQueue(wf)
+    q.run()
+    assert q.finished
+    q2 = RunQueue(wf, chunk=3)  # completed sweep: adoption is legal
+    assert wf._run_queue is q2
+
+
 def test_runqueue_admission_peels_init_hooks(tmp_path):
     """Admission of an init_ask/init_tell algorithm solo-peels the first
     generation (the fleet's steady step must never dispatch init hooks
@@ -590,7 +671,7 @@ def test_run_report_tenancy_section_valid():
                             hyperparams={"init_stdev": 1.0}))
     q.run()
     report = run_report(wf, q.state)
-    assert report["schema"] == "evox_tpu.run_report/v5"
+    assert report["schema"] == "evox_tpu.run_report/v6"
     ten = report["tenancy"]
     assert ten["n_tenants"] == 2
     assert ten["leading_axes"] == [2]

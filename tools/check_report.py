@@ -426,11 +426,122 @@ def _validate_executor(executor: Any, where: str) -> List[str]:
     return errors
 
 
+HEALTH_ACTIONS = {"freeze", "evict", "restart"}
+JOURNAL_KINDS = {
+    "submit",
+    "start",
+    "admit",
+    "chunk_complete",
+    "retire",
+    "evict",
+    "freeze",
+    "health",
+    "recover",
+}
+
+
+def _validate_journal(journal: Any, where: str) -> List[str]:
+    """``tenancy.queue.journal`` (schema v6, workflows/journal.py): the
+    WAL's event counters must be known kinds with non-negative counts
+    summing to the record total (monotonic by construction: records ==
+    last_seq + 1), and the ``recovered`` flag must agree with the
+    presence of a ``recover`` event."""
+    errors: List[str] = []
+    if not isinstance(journal, dict):
+        return [f"{where}: tenancy.queue.journal is not an object"]
+    events = journal.get("events")
+    if not isinstance(events, dict):
+        errors.append(f"{where}: tenancy.queue.journal.events missing")
+        events = {}
+    total = 0
+    for kind, count in events.items():
+        if kind not in JOURNAL_KINDS:
+            errors.append(
+                f"{where}: tenancy.queue.journal.events has unknown kind "
+                f"{kind!r}"
+            )
+        if not isinstance(count, int) or count < 0:
+            errors.append(
+                f"{where}: tenancy.queue.journal.events.{kind} not a "
+                "non-negative int"
+            )
+        else:
+            total += count
+    records = journal.get("records")
+    last_seq = journal.get("last_seq")
+    if not isinstance(records, int) or records < 0:
+        errors.append(f"{where}: tenancy.queue.journal.records missing")
+    else:
+        if events and total != records:
+            errors.append(
+                f"{where}: tenancy.queue.journal event counts sum to "
+                f"{total} but records is {records} — the counters are "
+                "not monotonic with the ledger"
+            )
+        if isinstance(last_seq, int) and last_seq != records - 1:
+            errors.append(
+                f"{where}: tenancy.queue.journal.last_seq {last_seq} != "
+                f"records-1 ({records - 1})"
+            )
+    recovered = journal.get("recovered")
+    if not isinstance(recovered, bool):
+        errors.append(f"{where}: tenancy.queue.journal.recovered missing")
+    elif recovered != (events.get("recover", 0) > 0):
+        errors.append(
+            f"{where}: tenancy.queue.journal.recovered {recovered} "
+            "incoherent with its recover event count "
+            f"{events.get('recover', 0)}"
+        )
+    return errors
+
+
+def _validate_fleet_health(health: Any, where: str, n: int) -> List[str]:
+    """``tenancy.fleet_health`` (schema v6, workflows/fleet_health.py):
+    every event names a real slot and a known action, with chunk indices
+    non-decreasing (the policy fires at chunk boundaries in order)."""
+    errors: List[str] = []
+    if not isinstance(health, dict):
+        return [f"{where}: tenancy.fleet_health is not an object"]
+    events = health.get("events")
+    if not isinstance(events, list):
+        return [f"{where}: tenancy.fleet_health.events missing"]
+    last_chunk = -1
+    for i, ev in enumerate(events):
+        loc = f"{where}: tenancy.fleet_health.events[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        if ev.get("action") not in HEALTH_ACTIONS:
+            errors.append(
+                f"{loc}.action {ev.get('action')!r} not in "
+                f"{sorted(HEALTH_ACTIONS)}"
+            )
+        slot = ev.get("slot")
+        if not isinstance(slot, int) or not 0 <= slot < n:
+            errors.append(
+                f"{loc}.slot {slot!r} not a valid slot index for an "
+                f"n_tenants={n} fleet"
+            )
+        if not isinstance(ev.get("reason"), str):
+            errors.append(f"{loc}.reason missing")
+        chunk = ev.get("chunk")
+        if not isinstance(chunk, int) or chunk < 0:
+            errors.append(f"{loc}.chunk missing/negative")
+        elif chunk < last_chunk:
+            errors.append(f"{loc}.chunk not non-decreasing")
+        else:
+            last_chunk = chunk
+    return errors
+
+
 def _validate_tenancy(tenancy: Any, where: str) -> List[str]:
     """The ``tenancy`` section (schema v3, workflows/tenancy.py): fleet
     shape coherent with the state's measured leading axes, per-tenant
     monitor counters non-negative with monotonic trajectory rings, and
-    sane RunQueue counters when a queue drove the fleet."""
+    sane RunQueue counters when a queue drove the fleet. v6 adds the
+    serving durability surfaces: ``queue.journal`` and
+    ``fleet_health``, and requires every evicted result of a journaled
+    queue to name its resumable checkpoint."""
     errors: List[str] = []
     if not isinstance(tenancy, dict):
         return [f"{where}: tenancy is not an object"]
@@ -526,6 +637,26 @@ def _validate_tenancy(tenancy: Any, where: str) -> List[str]:
                             f"{where}: tenancy.queue retired+evicted > "
                             "admitted"
                         )
+            journal = queue.get("journal")
+            if journal is not None:
+                errors += _validate_journal(journal, where)
+                # a journaled eviction's whole point is the resumable
+                # artifact: every evicted/frozen result must name the
+                # snapshot directory it parked its tenant in
+                for i, res in enumerate(queue.get("results") or []):
+                    if (
+                        isinstance(res, dict)
+                        and res.get("status") in ("evicted", "frozen")
+                        and not isinstance(res.get("checkpoint"), str)
+                    ):
+                        errors.append(
+                            f"{where}: tenancy.queue.results[{i}] is "
+                            f"{res.get('status')} under a journal but "
+                            "names no checkpoint path"
+                        )
+    health = tenancy.get("fleet_health")
+    if health is not None:
+        errors += _validate_fleet_health(health, where, n)
     return errors
 
 
@@ -587,6 +718,11 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
     if isinstance(ten, dict) and ten.get("run_report") is not None:
         errors += validate_run_report(
             ten["run_report"], where=f"{where}: tenancy.run_report"
+        )
+    if isinstance(ten, dict) and ten.get("serving_run_report") is not None:
+        errors += validate_run_report(
+            ten["serving_run_report"],
+            where=f"{where}: tenancy.serving_run_report",
         )
     lp = summary.get("large_pop")
     if isinstance(lp, dict):
