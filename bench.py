@@ -540,7 +540,7 @@ def tenancy_summary(results):
     from evox_tpu.problems.numerical import Sphere
 
     leg = next(
-        (r for r in results if "tenant" in r["metric"].lower()), None
+        (r for r in results if r.get("leg") == "tenancy"), None
     )
     if leg is None:
         return None
@@ -715,7 +715,7 @@ def executor_summary(results):
     from evox_tpu import GenerationExecutor, instrument, run_report
 
     leg = next(
-        (r for r in results if "overlap" in r["metric"].lower()), None
+        (r for r in results if r.get("leg") == "hosteval"), None
     )
     if leg is None:
         return None
@@ -828,7 +828,7 @@ def large_pop_summary(results):
     from evox_tpu.core.xla_cost import analyze_callable
 
     leg = next(
-        (r for r in results if "large-pop" in r["metric"].lower()), None
+        (r for r in results if r.get("leg") == "large_pop"), None
     )
     if leg is None:
         return None
@@ -899,6 +899,281 @@ def large_pop_summary(results):
             "core/instrument.py::_sharding_subsection"
         )
     return out
+
+
+# ------------------------------------------------------- elastic serving
+# PR 12: the serving_elastic leg. Two measurements, one leg entry:
+#
+# - value = SUSTAINED tenant-gens/sec under a seeded churning admission
+#   trace (tenants complete every other round; each completion admits the
+#   next queued spec by state surgery against the bucket's cached
+#   executables) — differenced over two serve-round counts so the
+#   constant server-build/warm cost cancels exactly like per-dispatch
+#   latency does on the other legs.
+# - vs_baseline + ratio_rounds = COLD-START speedup: fresh serving stack
+#   to first generation dispatched-and-fetched, warm AOT cache
+#   (deserialize from disk) vs the pre-elastic recompile path (a fresh
+#   fleet jit-compiling on first dispatch), interleaved rounds. The
+#   acceptance referee: the summary's serving.cold_start table records
+#   warm/cold/retrace medians plus the cache's own compile_s/load_s
+#   accounting (the static compile-ms table).
+#
+# Self-baselined (both sides are OURS): excluded from the geomean, the
+# bf16/tenancy precedent.
+
+SRV_DIM = 16
+SRV_WIDTH = 2
+SRV_CHUNK = 4
+SRV_TRACE = 24  # churn trace length (seeded); keeps both buckets busy
+SRV_PAIR = (3, 9)  # serve-round counts for the differenced slope
+SRV_COLD_ROUNDS = 3  # interleaved warm/retrace cold-start rounds
+SRV_METRIC = (
+    f"Elastic serving sustained tenant-gens/sec (seeded churning "
+    f"admission trace, {SRV_TRACE} requests with ragged pops bucketed "
+    f"onto pow2 rungs, width={SRV_WIDTH}, chunk={SRV_CHUNK}, "
+    f"dim={SRV_DIM}; vs_baseline is the COLD-START speedup — warm AOT "
+    "executable cache vs OUR pre-elastic recompile-on-dispatch path, "
+    "NOT the reference — excluded from the geomean; cold/warm/retrace "
+    "table and the compile-ms referee in the summary's "
+    "serving.cold_start)"
+)
+
+
+def _serving_factory(shape):
+    # PSO, deliberately: its program embeds no host custom calls, so the
+    # executables PERSIST off-TPU and the cold-start A/B measures the
+    # real disk path (CMA's eigh lowers to a LAPACK pointer the cache
+    # refuses to persist on CPU — see core/exec_cache.py)
+    from evox_tpu.algorithms.so.pso import PSO
+    from evox_tpu.monitors import TelemetryMonitor
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows.elastic import ACTIVE_ROWS, ElasticWorkflow
+
+    algo = PSO(
+        lb=-5.0 * jnp.ones(shape.dim),
+        ub=5.0 * jnp.ones(shape.dim),
+        pop_size=shape.pop,
+    )
+    return ElasticWorkflow(
+        algo,
+        Sphere(),
+        n_tenants=shape.width,
+        hyperparams={
+            ACTIVE_ROWS: jnp.full((shape.width,), shape.pop, jnp.int32)
+        },
+        monitors=(TelemetryMonitor(capacity=8),),
+    )
+
+
+def _serving_trace():
+    """The seeded admission trace: ragged pops spanning the 16 and 32
+    rungs, each spec living two serve rounds (n_steps = 2*chunk) so
+    completions churn admissions throughout the measured window."""
+    rng = np.random.RandomState(7)
+    return [
+        (int(rng.randint(9, 33)), 2 * SRV_CHUNK) for _ in range(SRV_TRACE)
+    ]
+
+
+def _serving_server(cache):
+    from evox_tpu.workflows.elastic import ElasticServer
+
+    return ElasticServer(
+        _serving_factory, cache=cache, width=SRV_WIDTH, chunk=SRV_CHUNK
+    )
+
+
+def bench_serving_churn(cache):
+    """() -> secs per serve round, differenced; scale = tenant-gens
+    dispatched per round (chunk × width × both buckets busy — the trace
+    keeps them busy past SRV_PAIR[1] rounds)."""
+    from evox_tpu.workflows.elastic import ElasticSpec
+
+    trace = _serving_trace()
+
+    def timed(n):
+        srv = _serving_server(cache)  # warm build: cancelled constant
+        for i, (pop, steps) in enumerate(trace):
+            srv.submit(
+                ElasticSpec(
+                    seed=i, n_steps=steps, pop=pop, dim=SRV_DIM,
+                    tag=f"churn{i}",
+                )
+            )
+        t0 = time.perf_counter()
+        srv.serve(max_rounds=n)
+        for b in srv._buckets.values():
+            if b.queue.state is not None:
+                _fetch(b.queue.state.generation)
+        return time.perf_counter() - t0
+
+    for n in SRV_PAIR:
+        timed(n)  # warm every bucket executable before timing
+    return _differenced(timed, *SRV_PAIR), SRV_CHUNK * SRV_WIDTH * 2
+
+
+def _serving_cold_start_warm(cache_dir):
+    """Fresh serving stack (fresh workflow objects — fresh jit wrappers,
+    no in-process tracing cache to lean on) warm-started from the
+    on-disk executable store: seconds to the first generation fetched."""
+    from evox_tpu.core.exec_cache import ExecutableCache
+    from evox_tpu.workflows.elastic import ElasticSpec
+
+    t0 = time.perf_counter()
+    srv = _serving_server(ExecutableCache(directory=cache_dir))
+    srv.submit(
+        ElasticSpec(seed=0, n_steps=SRV_CHUNK, pop=12, dim=SRV_DIM, tag="t")
+    )
+    srv.serve(max_rounds=1)
+    for b in srv._buckets.values():
+        _fetch(b.queue.state.generation)
+    dt = time.perf_counter() - t0
+    ctr = srv.cache.counters
+    if ctr["misses"]:
+        raise RuntimeError(
+            f"warm cold-start COMPILED ({ctr}) — the on-disk store did "
+            "not serve; the measured ratio would be a lie"
+        )
+    return dt
+
+
+def _serving_cold_start_retrace():
+    """The pre-elastic path: a fresh exact-shape fleet jit-compiling on
+    its first dispatch (what every mismatched tenant used to pay on the
+    critical path)."""
+    from evox_tpu import RunQueue, TenantSpec
+    from evox_tpu.workflows.elastic import BucketShape
+
+    t0 = time.perf_counter()
+    wf = _serving_factory(BucketShape(pop=16, dim=SRV_DIM, width=SRV_WIDTH))
+    q = RunQueue(wf, chunk=SRV_CHUNK)
+    for i in range(SRV_WIDTH):
+        q.submit(
+            TenantSpec(
+                seed=i, n_steps=SRV_CHUNK,
+                hyperparams={
+                    k: v[i] for k, v in wf.hyperparams.items()
+                },
+            )
+        )
+    q.start()
+    q.step_chunk()
+    _fetch(q.state.generation)
+    return time.perf_counter() - t0
+
+
+def serving_elastic_leg():
+    """Build the serving_elastic leg entry + the summary's `serving` key.
+    Returns (entry, summary) or (None, {"error": ...}) when the backend
+    cannot serialize executables (the cache degrades to memory-only and
+    the cold-start A/B has no honest warm side)."""
+    import shutil
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="bench_serving_")
+    try:
+        return _serving_elastic_leg_body(tmp)
+    finally:
+        # the stores hold serialized XLA executables (MBs per bucket);
+        # leaking one tree per bench run would slowly fill /tmp
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _serving_elastic_leg_body(tmp):
+    import warnings as _warnings
+
+    from evox_tpu import instrument, run_report
+    from evox_tpu.core.exec_cache import ExecutableCache
+    from evox_tpu.workflows.elastic import BucketShape, warm_fleet_cache
+
+    cache_dir = os.path.join(tmp, "exec_cache")
+    # warm the on-disk store once (the planned compile the cache
+    # exists to amortize) and verify this backend round-trips
+    # serialized executables; bail honestly where it cannot
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        _serving_server(ExecutableCache(directory=cache_dir))._get_bucket(
+            BucketShape(pop=16, dim=SRV_DIM, width=SRV_WIDTH)
+        )
+    if any("not serializable" in str(w.message) for w in caught):
+        return None, {
+            "error": (
+                "backend cannot serialize executables "
+                "(jax.experimental.serialize_executable); warm "
+                "cold-start unmeasurable here — run the leg in-container"
+            )
+        }
+    # interleaved cold-start rounds: warm (disk) vs retrace (recompile).
+    # One discarded warm-up round first — the very first deserialize and
+    # RunQueue drive pay one-time import/setup costs that belong to
+    # neither side of the A/B (the WARMUP discipline of the timed legs)
+    _serving_cold_start_warm(cache_dir)
+    warm_ts, retrace_ts, rounds = [], [], []
+    for _ in range(SRV_COLD_ROUNDS):
+        w = _serving_cold_start_warm(cache_dir)
+        r = _serving_cold_start_retrace()
+        warm_ts.append(w)
+        retrace_ts.append(r)
+        rounds.append(r / w)
+    # one full-cold round (empty store: compile + serialize + persist)
+    cold_dir = os.path.join(tmp, "exec_cache_cold")
+    cache_cold = ExecutableCache(directory=cold_dir)
+    t0 = time.perf_counter()
+    srv_cold = _serving_server(cache_cold)
+    srv_cold._get_bucket(BucketShape(pop=16, dim=SRV_DIM, width=SRV_WIDTH))
+    cold_s = time.perf_counter() - t0
+    # sustained churn throughput, warm cache (fresh memory cache over
+    # the warm store so the first build is a disk hit, not a compile)
+    churn_cache = ExecutableCache(directory=cache_dir)
+    measure, scale = bench_serving_churn(churn_cache)
+    ts = [t for t in (measure() for _ in range(INTERLEAVE_ROUNDS)) if t == t]
+    if not ts:
+        return None, {"error": "churn rounds all inverted (load noise)"}
+    entry = {
+        "metric": SRV_METRIC,
+        "value": round(scale / _median(ts), 3),
+        "unit": "tenant-gens/sec",
+        "vs_baseline": round(_median(rounds), 3),
+        "ratio_rounds": [round(r, 3) for r in rounds],
+    }
+    summary = dict(entry)
+    summary["cold_start"] = {
+        "spec": "fresh serving stack -> first generation fetched",
+        "warm_s": round(_median(warm_ts), 4),
+        "retrace_s": round(_median(retrace_ts), 4),
+        "cold_compile_s": round(cold_s, 4),
+        "warm_rounds_s": [round(t, 4) for t in warm_ts],
+        "retrace_rounds_s": [round(t, 4) for t in retrace_ts],
+        "speedup_warm_vs_retrace": entry["vs_baseline"],
+        # the static compile-ms referee: the store's own manifests
+        # record what each entry cost to compile and what the warm
+        # path paid to load instead
+        "compile_referee": {
+            "compile_s_recorded": round(cache_cold.compile_s_paid, 4),
+            "warm_load_s": round(churn_cache.load_s, 4),
+            "warm_compile_s_saved": round(churn_cache.compile_s_saved, 4),
+        },
+    }
+    # instrumented warm sample: run_report carries the serving.cache
+    # section (schema v7) + the serving buckets — with ZERO misses, the
+    # measured proof the warm path never recompiled
+    wf = _serving_factory(BucketShape(pop=16, dim=SRV_DIM, width=SRV_WIDTH))
+    sample_cache = ExecutableCache(directory=cache_dir)
+    warm_fleet_cache(
+        wf, sample_cache,
+        bucket=BucketShape(pop=16, dim=SRV_DIM, width=SRV_WIDTH),
+    )
+    sample_cache.freeze()  # any miss past here would raise, not compile
+    from evox_tpu.workflows.elastic import BucketTable
+
+    wf._bucket_table = BucketTable()
+    rec = instrument(wf, block_dispatch=True)
+    st = wf.init(jax.random.PRNGKey(5))
+    st = wf.run(st, SRV_PAIR[0])
+    st = wf.run(st, SRV_PAIR[1])
+    rec.fetch(st.generation, name="fleet_generation")
+    summary["run_report"] = run_report(wf, st, recorder=rec)
+    return entry, summary
 
 
 # ---------------------------------------------------------- run telemetry
@@ -1058,8 +1333,13 @@ ROOFLINES = {
     },
 }
 
+# Each entry: (leg name, metric, unit, ours builder, baseline builder,
+# roofline). The leg NAME is the `--legs` handle (ROADMAP item 2's
+# refactor unlock): chip rounds re-run exactly the legs whose code
+# changed instead of carrying every stale ratio through a full sweep.
 WORKLOADS = [
     (
+        "cso",
         f"CSO/Ackley evals/sec (pop={CSO_POP}, dim={CSO_DIM})",
         "evals/sec",
         bench_cso_ours,
@@ -1067,6 +1347,7 @@ WORKLOADS = [
         ROOFLINES["cso"],
     ),
     (
+        "cso_bf16",
         f"CSO/Ackley bf16-storage evals/sec (pop={CSO_POP}, dim={CSO_DIM}, "
         "DtypePolicy(bf16,f32); 'baseline' is OUR f32 CSO at identical "
         "shapes with the run carry donated on BOTH sides, NOT the "
@@ -1078,6 +1359,7 @@ WORKLOADS = [
         ROOFLINES["cso_bf16"],
     ),
     (
+        "rollout",
         f"OpenES+rollout evals/sec (pendulum MLP, pop={RO_POP})",
         "evals/sec",
         bench_rollout_ours,
@@ -1085,6 +1367,7 @@ WORKLOADS = [
         ROOFLINES["rollout"],
     ),
     (
+        "walker",
         f"OpenES+walker evals/sec (humanoid-scale: obs=244 act=17 "
         f"dim=20945, pop={W_POP})",
         "evals/sec",
@@ -1093,6 +1376,7 @@ WORKLOADS = [
         ROOFLINES["walker"],
     ),
     (
+        "nsga2",
         f"NSGA-II/LSMOP1 gens/sec (pop={MO_POP}, d={MO_DIM}, m={MO_M})",
         "gens/sec",
         bench_nsga2_ours,
@@ -1100,6 +1384,7 @@ WORKLOADS = [
         ROOFLINES["nsga2"],
     ),
     (
+        "walker_northstar",
         f"OpenES+walker evals/sec (north-star pop={W_POP_NS}, ours only "
         "-- reference cannot co-reside in HBM at this pop; ratio tracked "
         f"by the pop={W_POP} leg)",
@@ -1109,6 +1394,7 @@ WORKLOADS = [
         ROOFLINES["walker"],
     ),
     (
+        "tenancy",
         f"Multi-tenant CMA-ES runs/sec (tenant-gens/sec, pop={TEN_POP}, "
         f"dim={TEN_DIM}, N_tenants={TEN_N}; 'baseline' is the SAME {TEN_N} "
         "runs driven sequentially through one warm solo workflow, NOT the "
@@ -1122,6 +1408,7 @@ WORKLOADS = [
         ROOFLINES["tenancy"],
     ),
     (
+        "hosteval",
         f"Async-executor host-eval overlap evals/sec (pop={HE_POP}, "
         f"dim={HE_DIM}, {int(HE_SLEEP*1000)} ms host eval; 'baseline' is "
         "OUR OWN serialized per-step loop — the pre-executor drive shape "
@@ -1134,6 +1421,7 @@ WORKLOADS = [
         ROOFLINES["hosteval"],
     ),
     (
+        "large_pop",
         f"Sharded large-pop SepCMAES evals/sec (pop={LP_POP}, dim={LP_DIM}, "
         "gather-free POP-sharded ask/tell on the full device mesh; "
         "'baseline' is OUR replicated layout of the SAME per-shard "
@@ -1150,6 +1438,7 @@ WORKLOADS = [
         ROOFLINES["large_pop"],
     ),
     (
+        "islands",
         f"IslandWorkflow evals/sec ({ISL_N}x{ISL_POP} PSO islands, ring "
         f"migration every 8 gens, dim={ISL_DIM}; 'baseline' is OUR "
         "panmictic PSO at the same total budget, NOT the reference — "
@@ -1174,9 +1463,15 @@ NON_REFERENCE_BUILDERS = {
     bench_large_pop_sharded,  # A/B against OUR replicated sampling law
 }
 NON_REFERENCE_LEGS = {
-    metric for metric, _, ours_fn, _, _ in WORKLOADS
+    metric for _, metric, _, ours_fn, _, _ in WORKLOADS
     if ours_fn in NON_REFERENCE_BUILDERS
 }
+# the serving leg never enters the generic loop (its A/B is a cold-start
+# latency ratio, not a throughput ratio) but its metric line must still
+# be excluded from the geomean like every self-baselined leg
+NON_REFERENCE_LEGS.add(SRV_METRIC)
+
+LEG_NAMES = tuple(name for name, *_ in WORKLOADS) + ("serving_elastic",)
 
 
 def _median(xs):
@@ -1189,11 +1484,53 @@ def _ceilings():
     return CHIP_CEILINGS
 
 
-def main() -> None:
+def _parse_legs(argv):
+    """``--legs a,b,c`` (or repeated) → the ordered subset of leg names
+    to run; default every leg. ``--list-legs`` prints names and exits.
+    Unknown names fail loudly — a typo must not silently skip a leg and
+    carry last round's stale ratio forward."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--legs",
+        action="append",
+        default=None,
+        metavar="NAME[,NAME...]",
+        help=f"run only these legs (of: {', '.join(LEG_NAMES)})",
+    )
+    p.add_argument(
+        "--list-legs", action="store_true", help="print leg names and exit"
+    )
+    args = p.parse_args(argv)
+    if args.list_legs:
+        print("\n".join(LEG_NAMES))
+        raise SystemExit(0)
+    if args.legs is None:
+        return set(LEG_NAMES)
+    chosen = {
+        name.strip()
+        for chunk in args.legs
+        for name in chunk.split(",")
+        if name.strip()
+    }
+    unknown = chosen - set(LEG_NAMES)
+    if unknown:
+        p.error(
+            f"unknown leg(s) {sorted(unknown)}; choose from "
+            f"{', '.join(LEG_NAMES)}"
+        )
+    return chosen
+
+
+def main(argv=None) -> None:
+    legs = _parse_legs(sys.argv[1:] if argv is None else argv)
     _patch_reference_imports()
     sys.path.insert(0, "/root/reference/src")
     results = []
-    for metric, unit, ours_fn, ref_fn, roofline in WORKLOADS:
+    for name, metric, unit, ours_fn, ref_fn, roofline in WORKLOADS:
+        if name not in legs:
+            continue
         measure_ours, scale = ours_fn()
         if ref_fn is None:  # ours-only leg (e.g. north-star pop)
             measure_ref = None
@@ -1249,6 +1586,7 @@ def main() -> None:
             )
         ratio = _median(ratios) if ratios else None
         entry = {
+            "leg": name,
             "metric": metric,
             "value": round(ours, 3),
             "unit": unit,
@@ -1279,6 +1617,22 @@ def main() -> None:
         }
         results.append(entry)
         print(json.dumps(entry), flush=True)
+    serving = None
+    if "serving_elastic" in legs:
+        try:
+            serving_entry, serving = serving_elastic_leg()
+        except Exception as e:  # the leg must never sink the sweep
+            print(
+                f"serving_elastic leg failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            serving_entry, serving = None, {
+                "error": f"{type(e).__name__}: {e}"
+            }
+        if serving_entry is not None:
+            serving_entry = {"leg": "serving_elastic", **serving_entry}
+            results.append(serving_entry)
+            print(json.dumps(serving_entry), flush=True)
     ratios = [
         r["vs_baseline"]
         for r in results
@@ -1346,6 +1700,7 @@ def main() -> None:
                 "tenancy": tenancy,
                 "executor": executor,
                 "large_pop": large_pop,
+                "serving": serving,
                 "run_report": report,
             }
         )

@@ -22,6 +22,12 @@ from .distributed import (
     is_dist_initialized,
 )
 from .executor import GenerationExecutor
+from .exec_cache import (
+    ExecCacheError,
+    ExecCacheMissError,
+    ExecutableCache,
+    topology_fingerprint,
+)
 from .instrument import (
     DispatchRecorder,
     RetraceError,
@@ -52,6 +58,10 @@ __all__ = [
     "IPOPRestarts",
     "recenter_state",
     "GenerationExecutor",
+    "ExecutableCache",
+    "ExecCacheError",
+    "ExecCacheMissError",
+    "topology_fingerprint",
     "DispatchRecorder",
     "RetraceError",
     "CHIP_CEILINGS",

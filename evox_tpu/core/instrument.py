@@ -579,8 +579,12 @@ def run_report(
     # the serving fault-domain sections (workflows/journal.py +
     # fleet_health.py): `tenancy.queue.journal` (hash-chained WAL event
     # counters, recovered flag) and `tenancy.fleet_health` (per-tenant
-    # freeze/evict/restart action log) — validated when present.
-    report: dict = {"schema": "evox_tpu.run_report/v6"}
+    # freeze/evict/restart action log) — validated when present. v7 adds
+    # the optional `serving` section (core/exec_cache.py +
+    # workflows/elastic.py): the AOT executable cache's hit/miss/compile
+    # accounting (`serving.cache`) and the bucket lattice the workflow
+    # serves (`serving.buckets`) — validated when present.
+    report: dict = {"schema": "evox_tpu.run_report/v7"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -672,6 +676,19 @@ def run_report(
             )
             if sharding is not None:
                 report["roofline"]["sharding"] = sharding
+    # elastic serving (schema v7, duck-typed — core never imports the
+    # workflows package): a bucket workflow warmed through the AOT
+    # executable cache advertises it as `_exec_cache`
+    # (workflows/elastic.py warm_fleet_cache) and its lattice as
+    # `_bucket_table`; the cache's hit/miss/compile-seconds accounting
+    # is how a serving process proves its cold path never recompiled
+    cache = getattr(workflow, "_exec_cache", None)
+    if cache is not None and hasattr(cache, "report"):
+        serving: dict = {"cache": cache.report()}
+        table = getattr(workflow, "_bucket_table", None)
+        if table is not None and hasattr(table, "report"):
+            serving["buckets"] = table.report()
+        report["serving"] = serving
     if supervisor is None and workflow is not None:
         supervisor = getattr(workflow, "_run_supervisor", None)
     if supervisor is not None and hasattr(supervisor, "report"):

@@ -14,6 +14,16 @@ from .tenancy import (
     VectorizedWorkflow,
     VectorizedWorkflowState,
 )
+from .elastic import (
+    BucketError,
+    BucketShape,
+    BucketTable,
+    ElasticServer,
+    ElasticSpec,
+    ElasticWorkflow,
+    PopAutoscaler,
+    warm_fleet_cache,
+)
 from .supervisor import (
     DispatchDeadlineError,
     RunAbortedError,
@@ -30,6 +40,14 @@ __all__ = [
     "VectorizedWorkflowState",
     "RunQueue",
     "TenantSpec",
+    "BucketError",
+    "BucketShape",
+    "BucketTable",
+    "ElasticServer",
+    "ElasticSpec",
+    "ElasticWorkflow",
+    "PopAutoscaler",
+    "warm_fleet_cache",
     "WorkflowCheckpointer",
     "CheckpointConfigError",
     "restore_layouts",

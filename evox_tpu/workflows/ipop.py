@@ -42,7 +42,24 @@ import jax
 from ..core.guardrail import GuardedState, IPOPRestarts, recenter_state
 from .checkpoint import WorkflowCheckpointer, _as_checkpointer
 
-__all__ = ["ipop_run", "resolve_ipop_resume"]
+__all__ = ["grow_guarded", "ipop_run", "resolve_ipop_resume"]
+
+
+def grow_guarded(fresh: GuardedState, old: GuardedState) -> GuardedState:
+    """The increasing-population surgery shared by the host-boundary
+    doubling (:func:`ipop_run`) and the elastic serving autoscaler
+    (``workflows/elastic.py``): take a FRESH guarded state at the grown
+    λ, re-center its inner algorithm on the old best-so-far point, and
+    carry best/restart bookkeeping across the boundary — the trigger
+    that caused the growth is consumed (``checked_restarts`` catches up
+    to ``restarts``), so the same signal never double-fires."""
+    return fresh.replace(
+        inner=recenter_state(fresh.inner, old.best_x),
+        best_x=old.best_x,
+        best_fitness=old.best_fitness,
+        restarts=old.restarts,  # cumulative across the boundary
+        checked_restarts=old.restarts,  # this trigger is consumed
+    )
 
 
 def _require_guarded(astate: Any) -> None:
@@ -201,13 +218,8 @@ def _maybe_double(
         wf._ipop_events = events
     # fresh state from the wrapper's restart stream (folded per doubling:
     # deterministic, so a resumed run re-derives the identical successor)
-    fresh = algo2.init(jax.random.fold_in(algo_state.key, used))
-    fresh = fresh.replace(
-        inner=recenter_state(fresh.inner, algo_state.best_x),
-        best_x=algo_state.best_x,
-        best_fitness=algo_state.best_fitness,
-        restarts=algo_state.restarts,  # cumulative across the boundary
-        checked_restarts=algo_state.restarts,  # this trigger is consumed
+    fresh = grow_guarded(
+        algo2.init(jax.random.fold_in(algo_state.key, used)), algo_state
     )
     state = state.replace(algo=fresh, first_step=True)
     if checkpointer is not None:

@@ -66,6 +66,12 @@ EVENT_KINDS = (
     "freeze",
     "health",
     "recover",
+    # SLA serving (PR 12): a deadline-driven preemption close-out, and
+    # an elastic-autoscale close-out (the tenant grew into the next pop
+    # bucket; its continuation is a `submit` with `resume_from` in the
+    # TARGET bucket's journal)
+    "preempt",
+    "autoscale",
 )
 
 

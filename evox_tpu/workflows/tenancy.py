@@ -432,6 +432,12 @@ class VectorizedWorkflow:
         }
 
     # ------------------------------------------------------------- internals
+    def _filter_fitness(self, t: TenantState, fitness: jax.Array) -> jax.Array:
+        """Per-tenant fitness filter applied after quarantine, before the
+        fit transforms. Identity here; ``ElasticWorkflow`` overrides it
+        with the inert-row padding mask."""
+        return fitness
+
     def _flip(self, fitness: jax.Array) -> jax.Array:
         if fitness.ndim == 1:
             return fitness * self.opt_direction[0]
@@ -489,6 +495,12 @@ class VectorizedWorkflow:
         fitness = self._flip(fitness)
         if self.quarantine_nonfinite:
             fitness = quarantine_nonfinite(fitness)
+        # per-tenant fitness filter hook (identity here): the elastic
+        # layer (workflows/elastic.py) overrides it to make padded
+        # population rows inert — between the quarantine stage and the
+        # user fit transforms, the same pipeline position its solo
+        # reference applies the mask at
+        fitness = self._filter_fitness(t, fitness)
         for tr in self.fit_transforms:
             fitness = tr(fitness)
         run_hooks(self.monitors, self._hook_table, "pre_tell", mstates, fitness)
@@ -717,6 +729,29 @@ class VectorizedWorkflow:
             hyperparams=slot_hp,
         )
         new_t = apply_storage(new_t, self.dtype_policy)
+        # shape guard BEFORE the scatter: a solo state carrying another
+        # population size would either raise an opaque broadcasting error
+        # deep inside `.at[index].set` or — worse, for a pop that happens
+        # to broadcast — silently corrupt the slot. Mismatched shapes are
+        # a routing bug (e.g. a checkpoint from a different bucket); name
+        # it and point at the elastic router.
+        slot_leaves = jax.tree_util.tree_flatten_with_path(state.tenants)[0]
+        new_leaves = jax.tree_util.tree_flatten_with_path(new_t)[0]
+        if len(slot_leaves) == len(new_leaves):
+            for (path, stacked), (_, new) in zip(slot_leaves, new_leaves):
+                want = tuple(jnp.asarray(stacked).shape[1:])
+                got = tuple(jnp.asarray(new).shape)
+                if want != got:
+                    raise ValueError(
+                        f"insert_tenant: solo state leaf "
+                        f"{jax.tree_util.keystr(path)} has shape {got} but "
+                        f"fleet slot {index} holds {want} — the tenant was "
+                        "built for a different shape (population size, dim, "
+                        "or monitor capacity). Shapes are compiled into the "
+                        "fleet program; route mismatched requests through "
+                        "the bucket lattice (workflows/elastic.py "
+                        "ElasticServer) instead."
+                    )
 
         def put(stacked, new):
             stacked = jnp.asarray(stacked)
@@ -820,12 +855,29 @@ class VectorizedWorkflow:
 class TenantSpec:
     """One queued search: seed (int or PRNG key), concrete hyperparam
     bindings (must use the fleet's hyperparam names), a generation
-    budget, and an optional tag for the results table."""
+    budget, and an optional tag for the results table.
+
+    ``pop`` (optional) declares the population size the spec was built
+    for: admission validates it against the fleet's compiled pop at
+    ``submit()`` — a mismatch is a routing error named there, not a
+    shape error deep inside the fused vmapped step (route ragged pops
+    through ``workflows/elastic.py`` instead).
+
+    ``deadline`` (optional) is the SLA bound, measured in FLEET
+    generations since the queue started (``state.generation`` — a
+    deterministic clock, so journal recovery replays every scheduling
+    decision identically; wall-clock deadlines would not). A deadlined
+    spec is admitted in EDF order ahead of deadline-free work, and the
+    queue may PREEMPT the running tenant with the most remaining budget
+    (parked via the standard eviction checkpoint, auto-resubmitted as a
+    continuation) when waiting one more chunk would miss the deadline."""
 
     seed: Any
     n_steps: int
     hyperparams: Dict[str, Any] = dataclasses.field(default_factory=dict)
     tag: Optional[str] = None
+    pop: Optional[int] = None
+    deadline: Optional[int] = None
 
     def key(self) -> jax.Array:
         import numpy as np
@@ -867,8 +919,16 @@ def _spec_from_record(rec: dict) -> TenantSpec:
         n_steps=int(rec["n_steps"]),
         hyperparams=dict(rec.get("hyperparams") or {}),
         tag=rec.get("tag"),
+        pop=int(rec["pop"]) if rec.get("pop") is not None else None,
+        deadline=(
+            int(rec["deadline"]) if rec.get("deadline") is not None else None
+        ),
     )
     spec._journal_seq = int(rec["spec_seq"])
+    if rec.get("grows"):
+        # restore the elastic grow count (bounds PopAutoscaler.max_grows
+        # across recovery — a scheduling input like pop/deadline)
+        spec._elastic_grows = int(rec["grows"])
     return spec
 
 
@@ -999,6 +1059,10 @@ class RunQueue:
         self._spec_seq = 0
         self.finished = False
         self.pending: List[TenantSpec] = []
+        # parked continuations: specs whose tenant resumes from a
+        # checkpoint (preemption, elastic growth) instead of a fresh
+        # init — admitted ahead of deadline-free pending work
+        self.continuations: List[dict] = []
         self._used_dirs: set = set()
         self.slots: List[Optional[_Slot]] = [None] * workflow.n_tenants
         self.state: Optional[VectorizedWorkflowState] = None
@@ -1010,6 +1074,8 @@ class RunQueue:
             "evicted": 0,
             "frozen": 0,
             "restarted": 0,
+            "preempted": 0,
+            "readmitted": 0,
             "chunks": 0,
         }
         workflow._run_queue = self  # run_report pickup (tenancy.queue)
@@ -1022,10 +1088,20 @@ class RunQueue:
             "spec_seq": seq,
             "n_steps": int(spec.n_steps),
             "tag": spec.tag,
+            "pop": int(spec.pop) if spec.pop is not None else None,
+            "deadline": (
+                int(spec.deadline) if spec.deadline is not None else None
+            ),
             "hyperparams": {
                 k: np.asarray(v) for k, v in spec.hyperparams.items()
             },
         }
+        # the elastic layer's grow count is a SCHEDULING input (it
+        # bounds PopAutoscaler.max_grows): journal it like pop/deadline
+        # or a recovered queue would let a grown tenant grow forever
+        grows = getattr(spec, "_elastic_grows", 0)
+        if grows:
+            rec["grows"] = int(grows)
         seed = spec.seed
         if isinstance(seed, (int, np.integer)):
             rec["seed"] = int(seed)
@@ -1039,16 +1115,44 @@ class RunQueue:
             rec["seed_key_dtype"] = str(arr.dtype)
         return rec
 
-    def submit(self, spec: TenantSpec) -> None:
-        """Queue a spec. Validated HERE — a bad spec must be rejected at
-        the submission boundary, not discovered mid-sweep after it was
-        popped (which would lose it and leave the queue half-updated).
-        With a journal, the spec is durable before it is queued (WAL
-        discipline: an acknowledged submit survives a crash)."""
+    def _validate_spec(self, spec: TenantSpec) -> None:
         if spec.n_steps < 1:
             raise ValueError(
                 f"TenantSpec.n_steps must be >= 1, got {spec.n_steps}"
             )
+        fleet_pop = getattr(self.workflow.algorithm, "pop_size", None)
+        if (
+            spec.pop is not None
+            and fleet_pop is not None
+            and int(spec.pop) != int(fleet_pop)
+        ):
+            # the pre-elastic failure mode was a shape error deep inside
+            # the fused vmapped step, generations after the bad spec was
+            # accepted — reject it AT the submission boundary instead
+            raise ValueError(
+                f"TenantSpec.pop={spec.pop} does not match this fleet's "
+                f"compiled pop_size={fleet_pop}. A fleet program is "
+                "compiled at ONE population shape; admitting a mismatched "
+                "spec would fail (or silently mis-broadcast) inside the "
+                "fused step. Route ragged pops through the bucket lattice "
+                "(workflows/elastic.py ElasticServer) or build a fleet at "
+                "the requested pop."
+            )
+        if spec.deadline is not None:
+            if spec.deadline < spec.n_steps:
+                raise ValueError(
+                    f"TenantSpec.deadline={spec.deadline} is infeasible: "
+                    f"the spec needs n_steps={spec.n_steps} fleet "
+                    "generations even if admitted at generation 0"
+                )
+            if self.checkpoint_dir is None:
+                raise ValueError(
+                    "deadlined specs need a checkpoint_dir (or a journal): "
+                    "meeting a deadline may preempt a running tenant, and "
+                    "preemption parks the victim as a resumable eviction "
+                    "checkpoint — without a directory its work would be "
+                    "lost"
+                )
         if set(spec.hyperparams) != set(self.workflow.hyperparams):
             raise ValueError(
                 f"spec hyperparams {sorted(spec.hyperparams)} must use "
@@ -1057,27 +1161,93 @@ class RunQueue:
             )
         for name in spec.hyperparams:
             self.workflow._check_hp_name(name)
+
+    def _journal_submit(self, spec: TenantSpec, **extra: Any) -> None:
         seq = self._spec_seq
         if self.journal is not None:
-            self.journal.append("submit", **self._spec_record(spec, seq))
+            self.journal.append(
+                "submit", **self._spec_record(spec, seq), **extra
+            )
         spec._journal_seq = seq
         self._spec_seq += 1
         self.counters["submitted"] += 1
-        self.pending.append(spec)
         self.finished = False
 
+    def submit(self, spec: TenantSpec) -> None:
+        """Queue a spec. Validated HERE — a bad spec must be rejected at
+        the submission boundary, not discovered mid-sweep after it was
+        popped (which would lose it and leave the queue half-updated).
+        With a journal, the spec is durable before it is queued (WAL
+        discipline: an acknowledged submit survives a crash)."""
+        self._validate_spec(spec)
+        self._journal_submit(spec)
+        self.pending.append(spec)
+
+    def submit_resume(
+        self,
+        spec: TenantSpec,
+        checkpoint: Optional[str] = None,
+        state: Any = None,
+        done: Optional[int] = None,
+    ) -> None:
+        """Queue a CONTINUATION: a spec whose tenant resumes from a
+        parked solo state (a preemption/eviction/growth checkpoint, or
+        an in-memory state) instead of a fresh init. Continuations are
+        admitted ahead of deadline-free pending work — they were
+        displaced to make room, so they return before new arrivals.
+        ``done`` records the generations already completed at park time
+        (the SLA pass uses it to compute the continuation's REAL
+        remaining work instead of assuming the whole ``n_steps``).
+        With a journal a durable ``checkpoint`` is required: an
+        in-memory state would not survive the crash the journal exists
+        for. The journal records the submit with its ``resume_from``
+        path, so recovery rebuilds the continuation queue."""
+        self._validate_spec(spec)
+        if checkpoint is None and state is None:
+            raise ValueError(
+                "submit_resume needs a checkpoint directory or an "
+                "in-memory solo state to resume from"
+            )
+        if self.journal is not None and checkpoint is None:
+            raise ValueError(
+                "a journaled queue requires continuations to name a "
+                "durable checkpoint (resume_from) — an in-memory state "
+                "cannot be replayed after a crash"
+            )
+        self._journal_submit(
+            spec,
+            resume_from=checkpoint,
+            done=int(done) if done is not None else None,
+        )
+        self.continuations.append(
+            {
+                "spec": spec,
+                "seq": getattr(spec, "_journal_seq", None),
+                "checkpoint": checkpoint,
+                "state": state,
+                "done": int(done) if done is not None else None,
+            }
+        )
+
     def start(self) -> VectorizedWorkflowState:
-        """Fill every slot from the pending queue and init the fleet."""
+        """Fill every slot and init the fleet. Slots draw from pending
+        specs AND parked continuations under the ``_refill`` priority
+        ladder — a recovered queue whose remaining work is (mostly)
+        continuations (a cross-journal elastic-growth handoff crashed
+        before its target bucket ever started) must be startable, not
+        stuck behind a pending-only guard."""
         wf = self.workflow
         if self.state is not None:
             raise RuntimeError("RunQueue already started")
-        if len(self.pending) < wf.n_tenants:
+        total = len(self.pending) + len(self.continuations)
+        if total < wf.n_tenants:
             raise ValueError(
-                f"need at least n_tenants={wf.n_tenants} pending specs to "
-                f"fill the fleet, have {len(self.pending)}; submit more or "
-                "build a narrower fleet"
+                f"need at least n_tenants={wf.n_tenants} pending specs or "
+                f"parked continuations to fill the fleet, have {total}; "
+                "submit more or build a narrower fleet"
             )
-        specs = [self.pending.pop(0) for _ in range(wf.n_tenants)]
+        units = [self._take_next_unit() for _ in range(wf.n_tenants)]
+        specs = [u if k == "spec" else u["spec"] for k, u in units]
         keys = jnp.stack([s.key() for s in specs])
         hp = self._stack_hp([s.hyperparams for s in specs])
         state = wf.init(keys, hyperparams=hp)
@@ -1119,14 +1289,23 @@ class RunQueue:
             )
         self.state = state
         self.slots = [_Slot(spec=s) for s in specs]
-        self.counters["admitted"] += len(specs)
+        fresh = [i for i, (k, _) in enumerate(units) if k == "spec"]
+        self.counters["admitted"] += len(fresh)
         if self.journal is not None:
-            for i, s in enumerate(specs):
+            for i in fresh:
                 self.journal.append(
                     "admit",
                     slot=i,
-                    spec_seq=getattr(s, "_journal_seq", None),
+                    spec_seq=getattr(specs[i], "_journal_seq", None),
                     fleet_generation=0,
+                )
+        # continuation slots: the fresh-init state above is a shape
+        # donor only — replace it with the parked tenant by the standard
+        # surgery (which journals its own resumed admit and counts it)
+        for i, (k, u) in enumerate(units):
+            if k == "cont":
+                self._install(
+                    i, u["spec"], self._continuation_state(u), resumed=True
                 )
         return self.state
 
@@ -1182,7 +1361,7 @@ class RunQueue:
                 if (
                     (slot is None or not slot.active)
                     and not (slot is not None and slot.frozen)
-                    and self.pending
+                    and (self.pending or self.continuations)
                 ):
                     self._refill(i)
                     changed = True
@@ -1203,6 +1382,10 @@ class RunQueue:
         if self.state is None:
             self.start()
         gens = self._sweep()
+        # SLA pass BEFORE sizing the chunk: an urgent deadlined spec may
+        # preempt its way in, and the chunk length must honor the
+        # freshly admitted tenant's budget
+        gens = self._apply_sla(gens)
         active = [
             (i, s) for i, s in enumerate(self.slots)
             if s is not None and s.active
@@ -1210,16 +1393,25 @@ class RunQueue:
         if not active:
             self._finish()
             return False
-        n = min(
-            self.chunk,
-            min(s.spec.n_steps - gens[i] for i, s in active),
+        # int(): the budget term is np.int32 (the generation ledger) and
+        # the chunk term a python int — left mixed, the dispatched
+        # operand's abstract type flips between weak and strong int32
+        # depending on which term wins, which reads as a retrace to the
+        # strict detector watching the run entry
+        n = int(
+            min(
+                self.chunk,
+                min(s.spec.n_steps - gens[i] for i, s in active),
+            )
         )
         self._dispatch(n)
         self._sweep()
         self._apply_health_policy()
         self._barrier()
-        more = any(s is not None and s.active for s in self.slots) or bool(
-            self.pending
+        more = (
+            any(s is not None and s.active for s in self.slots)
+            or bool(self.pending)
+            or bool(self.continuations)
         )
         if not more:
             self._finish()
@@ -1267,6 +1459,14 @@ class RunQueue:
             snapshot=str(ckpt.directory / f"ckpt_{gen:08d}.pkl"),
             config_sha=self._config_sha,
             pending=[getattr(s, "_journal_seq", None) for s in self.pending],
+            continuations=[
+                {
+                    "seq": c.get("seq"),
+                    "checkpoint": c.get("checkpoint"),
+                    "done": c.get("done"),
+                }
+                for c in self.continuations
+            ],
             slots=[
                 None
                 if s is None
@@ -1415,9 +1615,12 @@ class RunQueue:
             ).items()
         }
         if self.journal is not None:
-            kind = {"evicted": "evict", "frozen": "freeze"}.get(
-                status, "retire"
-            )
+            kind = {
+                "evicted": "evict",
+                "frozen": "freeze",
+                "preempted": "preempt",
+                "grown": "autoscale",
+            }.get(status, "retire")
             self.journal.append(
                 kind,
                 result_seq=len(self.results),
@@ -1482,12 +1685,11 @@ class RunQueue:
         ):
             self.state = self.workflow.set_frozen(self.state, index, True)
 
-    def _refill(self, index: int) -> None:
-        """Admit the next pending spec into a freed slot, or park the
-        slot (it keeps stepping in lockstep; its results are ignored)."""
-        if not self.pending:
-            return
-        spec = self.pending.pop(0)  # validated at submit()
+    @staticmethod
+    def _edf_key(spec: TenantSpec):
+        return (spec.deadline, getattr(spec, "_journal_seq", 0))
+
+    def _fresh_tenant(self, spec: TenantSpec) -> TenantState:
         wf = self.workflow
         solo = wf.init_tenant(spec.key(), spec.hyperparams)
         if wf.algorithm.has_init_ask or wf.algorithm.has_init_tell:
@@ -1498,18 +1700,97 @@ class RunQueue:
             # the bindings as traced operands — one compile serves every
             # admission (and advances the tenant's own generation to 1)
             solo = wf._solo_peel(solo)
-        self.state = wf.insert_tenant(self.state, index, solo)
+        return solo
+
+    def _continuation_state(self, cont: dict) -> Any:
+        if cont.get("state") is not None:
+            return cont["state"]
+        from .checkpoint import _as_checkpointer
+
+        solo = _as_checkpointer(cont["checkpoint"]).latest()
+        if solo is None:
+            raise RuntimeError(
+                f"continuation checkpoint {cont['checkpoint']} holds no "
+                "intact snapshot — the parked tenant cannot be resumed"
+            )
+        return solo
+
+    def _refill(self, index: int) -> None:
+        """Admit the next unit of work into a freed slot, or park the
+        slot (it keeps stepping in lockstep; its results are ignored).
+        Priority: deadlined work in EDF order — pending specs AND parked
+        deadlined continuations compete in one EDF ladder (a preempted
+        deadlined victim keeps its SLA standing; exempting it would let
+        fresh deadlined arrivals starve it) — then parked continuations
+        (they were displaced to make room — they return before new FIFO
+        arrivals), then FIFO pending."""
+        if not self.pending and not self.continuations:
+            return
+        kind, unit = self._take_next_unit()
+        if kind == "spec":
+            self._install(index, unit, self._fresh_tenant(unit), resumed=False)
+        else:
+            self._install(
+                index,
+                unit["spec"],
+                self._continuation_state(unit),
+                resumed=True,
+            )
+
+    def _take_next_unit(self) -> Tuple[str, Any]:
+        """Remove and return the next admissible unit of work under the
+        ``_refill`` priority ladder: EDF across ALL deadlined work
+        (pending specs and parked continuations), then parked
+        continuations FIFO, then pending FIFO. Returns
+        ``("spec", TenantSpec)`` or ``("cont", continuation_dict)``."""
+        dl_cont = [
+            c for c in self.continuations
+            if c["spec"].deadline is not None
+        ]
+        best_c = (
+            min(dl_cont, key=lambda c: self._edf_key(c["spec"]))
+            if dl_cont
+            else None
+        )
+        dl_pend = [s for s in self.pending if s.deadline is not None]
+        best_p = min(dl_pend, key=self._edf_key) if dl_pend else None
+        if best_c is not None and (
+            best_p is None
+            or self._edf_key(best_c["spec"]) < self._edf_key(best_p)
+        ):
+            self.continuations.remove(best_c)
+            return ("cont", best_c)
+        if self.pending and (best_p is not None or not self.continuations):
+            if best_p is not None:
+                self.pending.remove(best_p)
+                return ("spec", best_p)
+            return ("spec", self.pending.pop(0))
+        return ("cont", self.continuations.pop(0))
+
+    def _install(
+        self, index: int, spec: TenantSpec, solo: Any, resumed: bool
+    ) -> None:
+        wf = self.workflow
+        hp = (
+            {k: jnp.asarray(v) for k, v in spec.hyperparams.items()}
+            if resumed
+            else None  # fresh TenantState carries its own bindings
+        )
+        self.state = wf.insert_tenant(self.state, index, solo, hyperparams=hp)
         if self.state.frozen is not None:
             self.state = wf.set_frozen(self.state, index, False)
         self.slots[index] = _Slot(spec=spec)
         self._slot_restarts[index] = 0
         self.counters["admitted"] += 1
+        if resumed:
+            self.counters["readmitted"] += 1
         if self.journal is not None:
             self.journal.append(
                 "admit",
                 slot=index,
                 spec_seq=getattr(spec, "_journal_seq", None),
                 fleet_generation=int(self.state.generation),
+                resumed=resumed,
             )
         # restore coherence: the supervisor's newest snapshot must
         # contain the ADMITTED tenant — its restore rung would otherwise
@@ -1519,6 +1800,124 @@ class RunQueue:
         ckpt = getattr(self.supervisor, "checkpointer", None)
         if ckpt is not None:
             ckpt.save(self.state)
+
+    # ------------------------------------------------------ SLA scheduling
+    def _apply_sla(self, gens):
+        """Deadline-weighted admission + preemption, evaluated before
+        each chunk dispatch. Every quantity is measured in fleet
+        generations or journal order — never wall clock — so recovery
+        replays the identical decisions (the PR-11 determinism law).
+
+        Rule: a pending deadlined spec that could NOT meet its deadline
+        after waiting one more chunk (``fleet_gen + chunk + n_steps >
+        deadline``) must be admitted now. If no slot is free, preempt
+        the "most over-budget" running tenant — the one holding its slot
+        longest (max remaining generations) among tenants that are not
+        deadline-tight themselves. The victim parks as a standard
+        eviction checkpoint and is auto-resubmitted as a continuation
+        (:meth:`submit_resume`): preemption trades the victim's latency,
+        never its work. Returns the refreshed generation ledger."""
+        # a deadlined tenant parked as a preemption continuation keeps
+        # competing under the same SLA contract as fresh deadlined
+        # arrivals: exempting it would let a stream of new deadlined
+        # specs starve it past its deadline with no escalation,
+        # contradicting "latency traded, never work"
+        units = sorted(
+            [("pending", s, s) for s in self.pending
+             if s.deadline is not None]
+            + [("cont", c, c["spec"]) for c in self.continuations
+               if c["spec"].deadline is not None],
+            key=lambda u: self._edf_key(u[2]),
+        )
+        if not units:
+            return gens
+        # ONE fetch for the whole pass: nothing below advances the
+        # fleet generation (preemption/admission are state surgery), and
+        # on the tunneled TPU every fetch is a 45-100 ms round trip
+        fleet_gen = int(self.state.generation)
+        for kind, unit, spec in units:
+            # remaining work: exact for a fresh spec, and for a parked
+            # continuation whose park-time progress was recorded
+            # (``done``); only a done-less continuation (a pre-PR-12
+            # journal) falls back to the n_steps upper bound with a
+            # 1-generation lower bound for the doomed test — err urgent
+            # on the wait side, only skip when provably lost
+            if kind == "pending":
+                remaining_hi = remaining_lo = spec.n_steps
+            elif unit.get("done") is not None:
+                remaining_hi = remaining_lo = max(
+                    spec.n_steps - int(unit["done"]), 1
+                )
+            else:
+                remaining_hi, remaining_lo = spec.n_steps, 1
+            if fleet_gen + remaining_lo > spec.deadline:
+                continue  # provably doomed: preemption cannot save it —
+                # it stays queued best-effort in EDF order; parking a
+                # healthy victim for a guaranteed miss is pure thrash
+            if fleet_gen + self.chunk + remaining_hi <= spec.deadline:
+                continue  # can still afford to wait one chunk
+            # a parked (refillable) slot admits without preemption —
+            # _sweep already refilled those in SLA order, so reaching
+            # here means every slot is busy (or frozen)
+            victim = self._preempt_victim(spec, gens, fleet_gen)
+            if victim is None:
+                continue  # nothing preemptible: best-effort, no thrash
+            self._preempt(victim)
+            if kind == "pending":
+                self.pending.remove(unit)
+                self._install(
+                    victim, spec, self._fresh_tenant(spec), resumed=False
+                )
+            else:
+                self.continuations.remove(unit)
+                self._install(
+                    victim, spec,
+                    self._continuation_state(unit), resumed=True,
+                )
+            # refresh the ledger NOW: a later unit's victim scan must
+            # see the just-installed tenant's (zero/resumed) progress,
+            # not the preempted tenant's — a stale count would let unit
+            # B immediately preempt unit A at zero generations of
+            # progress (pure thrash, A tight by construction)
+            gens = self._tenant_generations()
+        return gens
+
+    def _preempt_victim(
+        self, spec: TenantSpec, gens, fleet_gen: int
+    ) -> Optional[int]:
+        best, best_remaining = None, 0
+        for i, slot in enumerate(self.slots):
+            if slot is None or not slot.active or slot.frozen:
+                continue
+            remaining = int(slot.spec.n_steps - gens[i])
+            if remaining <= 0:
+                continue
+            d = slot.spec.deadline
+            if d is not None and fleet_gen + self.chunk + remaining > d:
+                continue  # itself deadline-tight: preempting it just
+                # moves the miss, never removes it
+            if remaining > best_remaining:
+                best, best_remaining = i, remaining
+        return best
+
+    def _preempt(self, index: int) -> None:
+        slot = self.slots[index]
+        self.counters["preempted"] += 1
+        entry = self._close_out(index, status="preempted", refill=False)
+        ckpt_dir = entry.get("checkpoint")
+        if ckpt_dir is None:
+            # _validate_spec guarantees a checkpoint_dir whenever a
+            # deadlined spec (the only preemption trigger) is accepted
+            raise RuntimeError(
+                "preempted a tenant without a checkpoint directory — "
+                "its work would be lost (this is a bug: deadlined specs "
+                "require checkpoint_dir at submit())"
+            )
+        self.submit_resume(
+            slot.spec,
+            checkpoint=ckpt_dir,
+            done=int(entry.get("generations") or 0),
+        )
 
     # ------------------------------------------------------------- recovery
     @classmethod
@@ -1558,9 +1957,20 @@ class RunQueue:
         )
         recs = journal.records()
         specs: Dict[int, TenantSpec] = {}
+        resume_from: Dict[int, Optional[str]] = {}
+        resume_done: Dict[int, Optional[int]] = {}
         for r in recs:
             if r["kind"] == "submit":
-                specs[int(r["spec_seq"])] = _spec_from_record(r)
+                seq = int(r["spec_seq"])
+                specs[seq] = _spec_from_record(r)
+                if r.get("resume_from") is not None:
+                    # a continuation submit (preemption / elastic
+                    # growth): its tenant resumes from the named
+                    # checkpoint, never a fresh init
+                    resume_from[seq] = r["resume_from"]
+                    resume_done[seq] = (
+                        int(r["done"]) if r.get("done") is not None else None
+                    )
         start = next((r for r in recs if r["kind"] == "start"), None)
         ckpt_dir = start.get("checkpoint_dir") if start is not None else None
         if (
@@ -1586,11 +1996,46 @@ class RunQueue:
         )
         q._spec_seq = max(specs, default=-1) + 1
         q.counters["submitted"] = len(specs)
+        def _requeue_all() -> None:
+            # continuations born from a preemption/growth close-out IN
+            # THIS journal are replay-derived: their original spec is
+            # requeued fresh below and the replay re-creates the
+            # continuation — requeueing both would run the tenant twice.
+            # Cross-journal continuations (elastic growth admits into
+            # the TARGET bucket's journal) have no matching close-out
+            # here and are kept.
+            derived = {
+                (r.get("entry") or {}).get("checkpoint")
+                for r in recs
+                if r["kind"] in ("preempt", "autoscale")
+            }
+            q.pending = [
+                specs[s] for s in sorted(specs) if s not in resume_from
+            ]
+            q.continuations = []
+            seen_ckpts: set = set()
+            for s in sorted(specs):
+                if s not in resume_from or resume_from[s] in derived:
+                    continue
+                if resume_from[s] in seen_ckpts:
+                    continue  # replay-duplicated submit for one parked
+                    # checkpoint (lowest seq wins — the claimed dedup)
+                seen_ckpts.add(resume_from[s])
+                q.continuations.append(
+                    {
+                        "spec": specs[s],
+                        "seq": s,
+                        "checkpoint": resume_from[s],
+                        "state": None,
+                        "done": resume_done.get(s),
+                    }
+                )
+
         if start is None:
             # crashed before (or during) start(): nothing ran to a
             # durable barrier — the whole sweep re-queues and starts
             # fresh, each spec still executed exactly once overall
-            q.pending = [specs[s] for s in sorted(specs)]
+            _requeue_all()
             journal.append("recover", generation=None, snapshot=None)
             return q
         # --- config guard (PR 5 fingerprint, reused): the supplied
@@ -1647,7 +2092,7 @@ class RunQueue:
         if meta is None:
             # start()ed but no barrier landed (killed in the first chunk
             # or mid-first-fsync): re-queue everything and start fresh
-            q.pending = [specs[s] for s in sorted(specs)]
+            _requeue_all()
             journal.append("recover", generation=None, snapshot=None)
             return q
         state = workflow.place_restored(state)
@@ -1659,6 +2104,18 @@ class RunQueue:
             state = workflow.with_freeze_mask(state)
         q.state = state
         q.pending = [specs[s] for s in meta["pending"]]
+        q.continuations = [
+            {
+                "spec": specs[int(c["seq"])],
+                "seq": int(c["seq"]),
+                "checkpoint": c.get("checkpoint"),
+                "state": None,
+                "done": (
+                    int(c["done"]) if c.get("done") is not None else None
+                ),
+            }
+            for c in meta.get("continuations", []) or []
+        ]
         q.slots = [
             None
             if s is None
@@ -1669,7 +2126,13 @@ class RunQueue:
             )
             for s in meta["slots"]
         ]
-        q.counters = {k: int(v) for k, v in meta["counters"].items()}
+        # merge (not replace): barriers written before a counter existed
+        # (older journals) must not strip it from the live dict
+        q.counters.update({k: int(v) for k, v in meta["counters"].items()})
+        # the WAL records every acknowledged submit — len(specs) is the
+        # ground truth, not the barrier-time snapshot (a spec submitted
+        # AFTER the barrier is requeued below and must stay counted)
+        q.counters["submitted"] = len(specs)
         q._slot_restarts = [
             int(v)
             for v in meta.get(
@@ -1682,9 +2145,73 @@ class RunQueue:
         closeouts = {
             int(r["result_seq"]): r["entry"]
             for r in recs
-            if r["kind"] in ("retire", "evict", "freeze")
+            if r["kind"] in (
+                "retire", "evict", "freeze", "preempt", "autoscale",
+            )
         }
         q.results = [closeouts[i] for i in range(int(meta["results_len"]))]
+        # --- mid-sweep submits (the WAL law: an ACKNOWLEDGED submit
+        # survives a crash). SLA work arrives mid-sweep by nature, so a
+        # spec journaled after the restored barrier appears in no
+        # barrier list — requeue every seq the barrier does not account
+        # for: not pending/parked/slotted at the barrier, and not closed
+        # out by a record that was durable BEFORE it (close-outs after
+        # the barrier describe progress the crash rolled back; their
+        # tenants are still in meta["slots"], so they stay accounted)
+        barrier_pos = next(
+            i for i, r in enumerate(recs) if r is meta
+        )
+        accounted = (
+            {int(s) for s in meta["pending"] if s is not None}
+            | {int(c["seq"]) for c in q.continuations}
+            | {
+                int(s["seq"]) for s in meta["slots"] if s is not None
+            }
+            | {
+                int(r["spec_seq"])
+                for r in recs[:barrier_pos]
+                if r["kind"]
+                in ("retire", "evict", "freeze", "preempt", "autoscale")
+                and r.get("spec_seq") is not None
+            }
+        )
+        # ...EXCEPT continuations born from a post-barrier preemption:
+        # their victim is still RUNNING in the restored slots, and the
+        # deterministic replay re-derives the preemption (and re-journals
+        # an identical continuation) — requeueing the crashed-off one
+        # would run the tenant twice
+        replay_derived = {
+            (r.get("entry") or {}).get("checkpoint")
+            for r in recs[barrier_pos:]
+            if r["kind"] in ("preempt", "autoscale")
+        }
+        # ...and dedup by the parked CHECKPOINT itself: after a PRIOR
+        # crash the replay re-journals a continuation under a NEW seq
+        # for the same parked checkpoint — once any seq resuming from
+        # that checkpoint is accounted (or requeued first, lowest seq
+        # wins), a second seq must not admit the same work twice
+        claimed = {
+            resume_from[s] for s in accounted if s in resume_from
+        }
+        for seq in sorted(specs):
+            if seq in accounted:
+                continue
+            if seq in resume_from:
+                ck = resume_from[seq]
+                if ck in replay_derived or ck in claimed:
+                    continue
+                claimed.add(ck)
+                q.continuations.append(
+                    {
+                        "spec": specs[seq],
+                        "seq": seq,
+                        "checkpoint": ck,
+                        "state": None,
+                        "done": resume_done.get(seq),
+                    }
+                )
+            else:
+                q.pending.append(specs[seq])
         healths = {
             int(r["health_seq"]): {
                 k: v
@@ -1736,6 +2263,7 @@ class RunQueue:
             "chunk": self.chunk,
             "counters": dict(self.counters),
             "pending": len(self.pending),
+            "continuations": len(self.continuations),
             "running": running,
             "results": [
                 {k: v for k, v in r.items() if k != "monitors"}

@@ -150,6 +150,112 @@ def driver_main(
     sys.exit(0 if kill_after_chunks is None and kill_fsync is None else 7)
 
 
+# ----------------------------------------------------------- SLA variant
+# PR 12: the deadline/preemption sweep the SIGKILL law must also cover —
+# two long deadline-free runs fill the fleet, an URGENT deadlined spec
+# arrives MID-SWEEP (after chunk 1) and preempts its way in around fleet
+# generation 6 (6 + chunk + 4 > 10). Kill points of interest: right
+# after the mid-sweep submit with NO following barrier (the
+# acknowledged-submit-survives law), and after the preemption barrier
+# (continuation + victim checkpoint must replay).
+
+SLA_WIDTH = 2
+SLA_LONG_STEPS = 15
+SLA_URGENT_STEPS, SLA_URGENT_DEADLINE = 4, 10
+
+
+def build_sla_workflow():
+    import jax.numpy as jnp
+
+    from evox_tpu import VectorizedWorkflow
+    from evox_tpu.algorithms.so.es import CMAES
+    from evox_tpu.monitors import TelemetryMonitor
+    from evox_tpu.problems.numerical import Sphere
+
+    algo = CMAES(center_init=jnp.ones(DIM), init_stdev=1.0, pop_size=POP)
+    return VectorizedWorkflow(
+        algo,
+        Sphere(),
+        n_tenants=SLA_WIDTH,
+        monitors=(TelemetryMonitor(capacity=8),),
+    )
+
+
+def build_sla_queue(journal_dir, ckpt_dir, workflow=None):
+    from evox_tpu import RunQueue
+
+    return RunQueue(
+        workflow if workflow is not None else build_sla_workflow(),
+        chunk=CHUNK,
+        journal=str(journal_dir),
+        checkpoint_dir=str(ckpt_dir),
+    )
+
+
+def _sla_urgent_spec():
+    from evox_tpu import TenantSpec
+
+    return TenantSpec(
+        seed=2,
+        n_steps=SLA_URGENT_STEPS,
+        tag="urgent",
+        deadline=SLA_URGENT_DEADLINE,
+    )
+
+
+def drive_sla_queue(q, kill_after_chunks: Optional[int] = None) -> None:
+    """The canonical SLA sweep: two longs, the urgent spec submitted
+    after chunk 1's barrier, SIGKILL after chunk ``kill_after_chunks``
+    (the submit lands BEFORE the kill check, so kill_after_chunks=1
+    kills with the urgent submit journaled but in no barrier)."""
+    from evox_tpu import TenantSpec
+
+    for i, tag in enumerate(("long0", "long1")):
+        q.submit(TenantSpec(seed=i, n_steps=SLA_LONG_STEPS, tag=tag))
+    q.start()
+    submitted = False
+    while True:
+        more = q.step_chunk()
+        if q.counters["chunks"] >= 1 and not submitted:
+            q.submit(_sla_urgent_spec())
+            submitted = True
+            more = True
+        if (
+            kill_after_chunks is not None
+            and q.counters["chunks"] >= kill_after_chunks
+        ):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if not more:
+            break
+
+
+def sla_driver_main(
+    journal_dir: str, ckpt_dir: str, kill_after_chunks: Optional[int]
+) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    q = build_sla_queue(journal_dir, ckpt_dir)
+    drive_sla_queue(q, kill_after_chunks)
+    sys.exit(0 if kill_after_chunks is None else 7)
+
+
+def run_sla_driver(
+    journal_dir, ckpt_dir, kill_after_chunks: int, timeout: float = 600.0
+) -> int:
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(
+        target=sla_driver_main,
+        args=(str(journal_dir), str(ckpt_dir), kill_after_chunks),
+        daemon=True,
+    )
+    p.start()
+    p.join(timeout)
+    if p.is_alive():
+        p.kill()
+        p.join()
+        raise RuntimeError("SLA chaos driver child hung past its timeout")
+    return p.exitcode
+
+
 def run_driver(
     journal_dir,
     kill_after_chunks: Optional[int] = None,

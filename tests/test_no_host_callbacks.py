@@ -245,3 +245,18 @@ def test_serving_fault_domain_modules_are_callback_free():
     for rel in ("workflows/journal.py", "workflows/fleet_health.py"):
         assert (PKG / rel).exists(), f"{rel} missing"
         assert rel not in users, f"{rel} must not use host callbacks"
+
+
+def test_elastic_serving_modules_are_callback_free():
+    """The ISSUE-12 elastic serving layer must hold the axon constraint
+    by construction: the executable cache is host-side file I/O + AOT
+    compilation (lower/compile/serialize happen OUTSIDE traced code),
+    and the bucket/admission/autoscale layer is host orchestration
+    between dispatches whose only traced addition (the inert-row mask)
+    is pure lax math — a host callback in either would make elastic
+    serving unusable on the tunneled TPU whose compile costs it
+    exists to hide."""
+    users = _scan()
+    for rel in ("core/exec_cache.py", "workflows/elastic.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"

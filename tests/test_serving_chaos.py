@@ -233,6 +233,82 @@ def test_driver_sigkill_pre_rename_fsync(tmp_path, reference):
     _assert_crash_equivalent(jd, reference)
 
 
+# -------------------------------------------------- SLA preemption crashes
+
+
+def _sla_completed_digest(results):
+    """The schedule-independent half of the crash law: every spec's
+    COMPLETED trajectory (tag, generations, telemetry fingerprint).
+    Preempt/resume is trajectory-preserving, so this digest is identical
+    whether the urgent spec preempted its way in mid-sweep or was
+    EDF-admitted up front after a restart-fresh recovery."""
+    return sorted(
+        (r["tag"], r["generations"], tuple(r.get("fingerprints") or ()))
+        for r in results
+        if r["status"] == "completed"
+    )
+
+
+@pytest.fixture(scope="module")
+def sla_reference(tmp_path_factory):
+    """The uncrashed SLA sweep (ISSUE 12): two long runs, a mid-sweep
+    urgent deadlined spec that preempts its way in, the victim resuming
+    from its parked checkpoint."""
+    base = tmp_path_factory.mktemp("sla_ref")
+    q = pc.build_sla_queue(base / "wal", base / "ckpt")
+    pc.drive_sla_queue(q)
+    assert q.counters["preempted"] == 1, q.counters
+    statuses = sorted(r["status"] for r in q.results)
+    assert statuses == ["completed", "completed", "completed", "preempted"]
+    return {
+        "digest": sorted(pc.result_digest(q.results)),
+        "completed": _sla_completed_digest(q.results),
+    }
+
+
+@pytest.mark.proc_chaos
+@pytest.mark.parametrize("kill_at", [1, 3, 5])
+def test_sla_preemption_sigkill_recovery(tmp_path, sla_reference, kill_at):
+    """SLA preemption → journal → recover equivalence through a REAL
+    driver SIGKILL. kill_at=1 dies right after the urgent MID-SWEEP
+    submit with no following barrier (the acknowledged-submit-survives
+    WAL law); kill_at=3 dies just past the preemption barrier;
+    kill_at=5 mid-continuation.
+
+    Two legal recovery outcomes, both asserted exactly:
+    - a chunk barrier's background snapshot survived → the replay
+      re-derives the EDF + preemption schedule deterministically
+      (fleet-generation clock, never wall clock) and the FULL digest,
+      preemption ledger included, matches the uncrashed run's;
+    - the kill out-raced every background snapshot (possible at
+      kill_at=1) → recovery restarts fresh, where EDF legally admits
+      the urgent spec up front and no preemption is needed — the
+      schedule-independent completed-trajectory digest still matches
+      bitwise and every spec runs exactly once.
+    """
+    jd, cd = tmp_path / "wal", tmp_path / "ckpt"
+    code = pc.run_sla_driver(jd, cd, kill_after_chunks=kill_at)
+    assert code == -signal.SIGKILL
+    q = RunQueue.recover(pc.build_sla_workflow(), str(jd))
+    restored = next(
+        r for r in q.journal.records() if r["kind"] == "recover"
+    )
+    q.run()
+    # exactly once, work preserved, bit-identical completed trajectories
+    assert _sla_completed_digest(q.results) == sla_reference["completed"]
+    if restored["generation"] is not None:
+        # barrier restored: the schedule replay is exact
+        assert sorted(pc.result_digest(q.results)) == sla_reference["digest"]
+        assert q.counters["preempted"] == 1
+    else:
+        # restart-fresh (snapshot race): urgent EDF-admitted up front
+        assert kill_at == 1, "only the first barrier's snapshot can race"
+        assert q.counters["preempted"] == 0
+    rep = run_report(q.workflow, q.state)
+    assert rep["tenancy"]["queue"]["journal"]["recovered"] is True
+    assert check_report.validate_run_report(rep) == []
+
+
 def test_recover_config_mismatch_raises(reference):
     """The PR-5 config guard, reused at the journal layer: a workflow
     whose fleet structure differs from the journaled one is refused."""

@@ -165,6 +165,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     tenancy = report.get("tenancy")
     if tenancy is not None:
         errors += _validate_tenancy(tenancy, where)
+    serving = report.get("serving")
+    if serving is not None:
+        errors += _validate_serving(serving, where)
     executor = report.get("executor")
     if executor is not None:
         errors += _validate_executor(executor, where)
@@ -437,6 +440,9 @@ JOURNAL_KINDS = {
     "freeze",
     "health",
     "recover",
+    # v7 (PR 12): SLA preemption and elastic-autoscale close-outs
+    "preempt",
+    "autoscale",
 }
 
 
@@ -646,9 +652,12 @@ def _validate_tenancy(tenancy: Any, where: str) -> List[str]:
                 for i, res in enumerate(queue.get("results") or []):
                     if (
                         isinstance(res, dict)
-                        and res.get("status") in ("evicted", "frozen")
+                        and res.get("status")
+                        in ("evicted", "frozen", "preempted")
                         and not isinstance(res.get("checkpoint"), str)
                     ):
+                        # v7 adds preempted: its continuation resumes
+                        # from exactly this artifact
                         errors.append(
                             f"{where}: tenancy.queue.results[{i}] is "
                             f"{res.get('status')} under a journal but "
@@ -657,6 +666,126 @@ def _validate_tenancy(tenancy: Any, where: str) -> List[str]:
     health = tenancy.get("fleet_health")
     if health is not None:
         errors += _validate_fleet_health(health, where, n)
+    return errors
+
+
+SERVING_CACHE_COUNTERS = ("hits", "disk_hits", "misses", "saves", "evictions")
+SERVING_ENTRY_SOURCES = {"compiled", "disk"}
+
+
+def _validate_serving(serving: Any, where: str) -> List[str]:
+    """The ``serving`` section (schema v7, core/exec_cache.py +
+    workflows/elastic.py): the AOT executable cache's hit/miss/compile
+    accounting and the bucket lattice. Coherence rules: every miss is a
+    compile event (``misses`` == entries recorded ``source: compiled``),
+    every disk hit a deserialize (``disk_hits`` == entries ``source:
+    disk``), byte/seconds traffic finite and non-negative, and every
+    entry bucket must sit ON the advertised lattice (an off-lattice
+    bucket id means the router and the cache disagree about shapes)."""
+    errors: List[str] = []
+    if not isinstance(serving, dict):
+        return [f"{where}: serving is not an object"]
+    cache = serving.get("cache")
+    if not isinstance(cache, dict):
+        return [f"{where}: serving.cache missing — the section's point"]
+    counters = cache.get("counters")
+    if not isinstance(counters, dict):
+        errors.append(f"{where}: serving.cache.counters missing")
+        counters = {}
+    for key in SERVING_CACHE_COUNTERS:
+        v = counters.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: serving.cache.counters.{key} missing or not a "
+                "non-negative int"
+            )
+    for key in (
+        "compile_s_paid",
+        "compile_s_saved",
+        "load_s",
+        "bytes_written",
+        "bytes_read",
+    ):
+        v = cache.get(key)
+        if not _num(v) or v < 0:
+            errors.append(
+                f"{where}: serving.cache.{key} missing or negative"
+            )
+    entries = cache.get("entries")
+    if not isinstance(entries, list):
+        errors.append(f"{where}: serving.cache.entries missing")
+        entries = []
+    compiled = disk = 0
+    buckets = serving.get("buckets")
+    pop_rungs = (buckets or {}).get("pop_rungs") if isinstance(
+        buckets, dict
+    ) else None
+    width_rungs = (buckets or {}).get("width_rungs") if isinstance(
+        buckets, dict
+    ) else None
+    for i, e in enumerate(entries):
+        loc = f"{where}: serving.cache.entries[{i}]"
+        if not isinstance(e, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        src = e.get("source")
+        if src not in SERVING_ENTRY_SOURCES:
+            errors.append(
+                f"{loc}.source {src!r} not in {sorted(SERVING_ENTRY_SOURCES)}"
+            )
+        # repeat events for one (key, source) aggregate into a single
+        # record's `repeats` count (the cache's unbounded-growth guard)
+        repeats = e.get("repeats", 1)
+        if not isinstance(repeats, int) or repeats < 1:
+            errors.append(f"{loc}.repeats {repeats!r} is not a positive int")
+            repeats = 1
+        compiled += (src == "compiled") * repeats
+        disk += (src == "disk") * repeats
+        b = e.get("bucket")
+        if b is not None:
+            if (
+                not isinstance(b, list)
+                or len(b) != 3
+                or not all(isinstance(x, int) and x > 0 for x in b)
+            ):
+                errors.append(
+                    f"{loc}.bucket {b!r} is not a [pop, dim, width] triple"
+                )
+            elif pop_rungs is not None and width_rungs is not None:
+                pop, _, width = b
+                if pop not in pop_rungs or width not in width_rungs:
+                    errors.append(
+                        f"{loc}.bucket {b} is off the advertised lattice "
+                        f"(pop_rungs={pop_rungs}, width_rungs={width_rungs})"
+                        " — router and cache disagree about shapes"
+                    )
+    # the coherence law: a miss IS a compile event, a disk hit IS a
+    # deserialize event — counters that drift from the entry provenance
+    # mean the accounting (the leg's whole evidence) is broken
+    if isinstance(counters.get("misses"), int) and counters["misses"] != compiled:
+        errors.append(
+            f"{where}: serving.cache counts {counters['misses']} misses "
+            f"but records {compiled} compiled entries — every miss must "
+            "be exactly one compile event"
+        )
+    if isinstance(counters.get("disk_hits"), int) and counters["disk_hits"] != disk:
+        errors.append(
+            f"{where}: serving.cache counts {counters['disk_hits']} disk "
+            f"hits but records {disk} disk-sourced entries"
+        )
+    if isinstance(buckets, dict):
+        for key in ("pop_rungs", "width_rungs"):
+            rungs = buckets.get(key)
+            if (
+                not isinstance(rungs, list)
+                or not rungs
+                or not all(isinstance(r, int) and r > 0 for r in rungs)
+                or rungs != sorted(rungs)
+            ):
+                errors.append(
+                    f"{where}: serving.buckets.{key} is not a sorted "
+                    "positive-int list"
+                )
     return errors
 
 
@@ -696,6 +825,9 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             ("tenant", "its sequential-baseline ratio"),
             ("overlap", "its sequential-loop ratio"),
             ("large-pop", "its replicated-baseline ratio"),
+            # v7: the serving_elastic leg's vs_baseline is the measured
+            # warm-vs-recompile cold-start speedup — the PR-12 claim
+            ("elastic serving", "its cold-start (warm vs recompile) ratio"),
         ):
             if keyword not in metric_l:
                 continue
@@ -764,6 +896,54 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
                         f"peak {sh} >= replicated peak {rp} — sharding "
                         "bought no memory"
                     )
+    sv = summary.get("serving")
+    if isinstance(sv, dict) and "error" not in sv:
+        cs = sv.get("cold_start")
+        if not isinstance(cs, dict):
+            errors.append(
+                f"{where}: serving.cold_start missing — the cold-start "
+                "claim is unmeasured"
+            )
+        else:
+            for key in ("warm_s", "retrace_s", "cold_compile_s"):
+                v = cs.get(key)
+                if not _num(v) or v <= 0:
+                    errors.append(
+                        f"{where}: serving.cold_start.{key} missing or "
+                        "non-positive"
+                    )
+            ref = cs.get("compile_referee")
+            if not isinstance(ref, dict) or not all(
+                _num(ref.get(k)) and ref[k] >= 0
+                for k in (
+                    "compile_s_recorded",
+                    "warm_load_s",
+                    "warm_compile_s_saved",
+                )
+            ):
+                errors.append(
+                    f"{where}: serving.cold_start.compile_referee missing "
+                    "its compile/load seconds — the static compile-ms "
+                    "table is the honesty referee"
+                )
+        rr_sv = sv.get("run_report")
+        if rr_sv is None:
+            errors.append(
+                f"{where}: serving.run_report missing — the warm sample's "
+                "serving.cache section is the zero-recompile evidence"
+            )
+        else:
+            errors += validate_run_report(
+                rr_sv, where=f"{where}: serving.run_report"
+            )
+            if not isinstance(
+                (rr_sv.get("serving") or {}).get("cache"), dict
+            ):
+                errors.append(
+                    f"{where}: serving.run_report carries no "
+                    "serving.cache section — the warm sample was not "
+                    "driven through the executable cache"
+                )
     ex = summary.get("executor")
     if isinstance(ex, dict):
         if ex.get("run_report") is not None:
