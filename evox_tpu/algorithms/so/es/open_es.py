@@ -53,6 +53,14 @@ class OpenES(Algorithm):
         self.noise_stdev = noise_stdev
         self.mirrored = mirrored_sampling
         self.optimizer = make_optimizer(optimizer, learning_rate)
+        # traced learning-rate multiplier on the optimizer's updates: the
+        # optimizer's own learning rate is baked into its optax closure at
+        # construction (not bindable as a traced hyperparameter), so
+        # fleet/multi-level hyperparameter adaptation rebinds THIS knob
+        # instead (workflows/tenancy.py hyperparams, workflows/
+        # multilevel.py HyperSpec). The 1.0 default compiles to the exact
+        # pre-knob program (the multiply is skipped statically below).
+        self.lr_scale = 1.0
 
     def init(self, key: jax.Array) -> OpenESState:
         key, k = jax.random.split(key)
@@ -98,6 +106,10 @@ class OpenES(Algorithm):
             grad = noise.T @ fitness
         grad = grad / (self.pop_size * self.noise_stdev)
         updates, opt_state = self.optimizer.update(grad, state.opt_state, state.center)
+        if not (isinstance(self.lr_scale, float) and self.lr_scale == 1.0):
+            # only reached when lr_scale was rebound (a traced tenant /
+            # multi-level hyperparameter, or an explicit non-1 float)
+            updates = jax.tree.map(lambda u: u * self.lr_scale, updates)
         return state.replace(
             center=optax.apply_updates(state.center, updates),
             opt_state=opt_state,

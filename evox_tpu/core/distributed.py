@@ -8,17 +8,25 @@ replicated; collectives (all_gather / psum over fitness) ride ICI within a
 TPU slice and DCN across slices, inserted either automatically by GSPMD from
 sharding constraints or explicitly inside ``shard_map`` islands.
 
-Multi-host: call :func:`init_distributed` (a thin wrapper over
-``jax.distributed.initialize``) on every host, then build the mesh over
-``jax.devices()`` — the same single-program step then runs SPMD across the
-whole pod, which is the TPU-native equivalent of the reference's
-``jax.distributed`` + NCCL path and entirely replaces its Ray RPC path for
-jittable problems.
+Multi-host: call :func:`init_distributed` (an idempotency-guarded wrapper
+over ``jax.distributed.initialize``) on every process FIRST, build the
+global mesh with :func:`create_pod_mesh` (pod-ordered devices: each
+process's local devices contiguous along the sharded axis), assemble
+eager states into global arrays with :func:`ensure_global_state` — the
+same single-program step then runs SPMD across the whole pod, which is
+the TPU-native equivalent of the reference's ``jax.distributed`` + NCCL
+path and entirely replaces its Ray RPC path for jittable problems.
+Host-side rendezvous (checkpoint commits) rides :func:`process_barrier`;
+cross-process host readbacks ride :func:`host_value`. The whole layer is
+exercised end to end by ``__graft_entry__.dryrun_multihost(n)``
+(real coordinator + n worker processes; GUIDE.md §6 "going multi-host").
 """
 
 from __future__ import annotations
 
+import functools
 import re
+import warnings
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -55,6 +63,14 @@ __all__ = [
     "process_id",
     "process_count",
     "is_dist_initialized",
+    "pod_devices",
+    "create_pod_mesh",
+    "mesh_spans_processes",
+    "process_barrier",
+    "assemble_global_array",
+    "host_value",
+    "tree_host_value",
+    "ensure_global_state",
 ]
 
 
@@ -299,9 +315,16 @@ def place_state(
 ) -> Any:
     """Eager: ``device_put`` every leaf onto its annotated sharding
     (``rules``/``axis_prefix`` as :func:`state_sharding` — the restore
-    path for tenant-stacked fleet snapshots)."""
+    path for tenant-stacked fleet snapshots). On a mesh spanning
+    processes this routes through :func:`ensure_global_state` — each
+    process assembles only its addressable shards from the full host
+    value (the process-count-portable checkpoint-restore path)."""
     if mesh is None:
         return state
+    if mesh_spans_processes(mesh):
+        return ensure_global_state(
+            state, mesh, rules=rules, axis_prefix=axis_prefix
+        )
     shardings = state_sharding(
         state, mesh, rules=rules, axis_prefix=axis_prefix
     )
@@ -322,10 +345,13 @@ def place_pop(tree: Any, mesh: Optional[Mesh], axis_name: str = POP_AXIS) -> Any
     """EAGER placement: ``device_put`` every leaf with its leading axis
     sharded over ``axis_name``. Use when loading host data or a restored
     checkpoint into a mesh layout (``shard_pop`` is the tracing-time
-    constraint form and only works inside jit)."""
+    constraint form and only works inside jit). Pod meshes assemble the
+    per-process shards (:func:`assemble_global_array`)."""
     if mesh is None:
         return tree
     s = pop_sharding(mesh, axis_name)
+    if mesh_spans_processes(mesh):
+        return jax.tree.map(lambda x: assemble_global_array(x, s), tree)
     return jax.tree.map(lambda x: jax.device_put(x, s), tree)
 
 
@@ -560,7 +586,23 @@ class ShardedES:
             )
         # eager: compile init with its OUTPUT shardings pinned to the field
         # annotations, so the (pop, dim) buffers are born sharded — never
-        # materialized on one device and re-placed
+        # materialized on one device and re-placed. On a pod mesh the key
+        # operand must itself be a GLOBAL (replicated) array first — a
+        # process-local committed array is not a legal global-jit operand
+        if mesh_spans_processes(self.mesh):
+            rep = NamedSharding(self.mesh, P())
+            if _is_typed_key(key):
+                key = jax.random.wrap_key_data(
+                    assemble_global_array(
+                        np.asarray(jax.device_get(jax.random.key_data(key))),
+                        rep,
+                    ),
+                    impl=jax.random.key_impl(key),
+                )
+            else:
+                key = assemble_global_array(
+                    np.asarray(jax.device_get(key)), rep
+                )
         sds = jax.eval_shape(self.algorithm.init, key)
         shardings = self._state_shardings(sds)
         return jax.jit(self.algorithm.init, out_shardings=shardings)(key)
@@ -628,23 +670,154 @@ class ShardedES:
         )
 
 
+# --------------------------------------------------------------------------
+# Multi-process (pod-style) execution (PR 13, ROADMAP item 3).
+#
+# jax's multi-controller model: every process runs the SAME program over a
+# mesh built from the GLOBAL device list (`jax.devices()` spans processes
+# once `jax.distributed` is initialized); each process physically owns only
+# its local devices, GSPMD inserts the cross-host collectives. Three host-
+# side obligations fall out, owned by the helpers below:
+#
+# - mesh construction must put each process's local devices in a CONTIGUOUS
+#   block of the sharded axis (`create_pod_mesh` sorts by (process_index,
+#   id)), so a per-process data shard is a contiguous slice;
+# - eager values (fresh inits, restored checkpoints) must become GLOBAL
+#   arrays before a global-mesh jit may consume them — each process builds
+#   its addressable shards from the full host value with
+#   ``jax.make_array_from_single_device_arrays`` (`assemble_global_array` /
+#   `ensure_global_state`); a plain ``device_put`` onto a cross-process
+#   sharding is not legal;
+# - host readbacks of a cross-process-sharded array must all-gather first
+#   (`host_value`: a jitted identity with replicated out_shardings), and
+#   host-side rendezvous (checkpoint commit) goes through the coordinator's
+#   KV store (`process_barrier`) — no XLA collective, so it works even
+#   where the backend cannot run one.
+#
+# `constrain_state` itself is already collective-aware: it is a TRACE-time
+# constraint, and on a pod mesh GSPMD lowers the declared layouts to
+# ICI/DCN collectives exactly as on a single host. The eager twin
+# `place_state` routes through the assembly path on pod meshes.
+
+# what THIS process passed to init_distributed (guards a second call even
+# on jax builds whose global_state exposes nothing)
+_INIT_RECORD: Optional[dict] = None
+
+
+#: sentinel: the jax build exposes no distributed introspection at all
+#: (distinct from "introspection works and there is no client")
+_INTROSPECT_FAILED = object()
+
+
+def _dist_client():
+    """The live distributed-runtime client, None when introspection works
+    and none is active, or :data:`_INTROSPECT_FAILED` on jax builds
+    without `jax._src.distributed.global_state` (the only introspection
+    point jax exposes)."""
+    try:
+        from jax._src import distributed as _jd
+
+        return _jd.global_state.client
+    except Exception:  # pragma: no cover - exotic jax builds
+        return _INTROSPECT_FAILED
+
+
+def _current_dist_config() -> dict:
+    """Best-effort record of the ACTIVE jax.distributed configuration."""
+    cfg: dict = dict(_INIT_RECORD or {})
+    try:
+        from jax._src import distributed as _jd
+
+        gs = _jd.global_state
+        for ours, theirs in (
+            ("coordinator_address", "coordinator_address"),
+            ("num_processes", "num_processes"),
+            ("process_id", "process_id"),
+        ):
+            val = getattr(gs, theirs, None)
+            if val is not None:
+                cfg[ours] = val
+    except Exception:  # pragma: no cover
+        pass
+    return cfg
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     **kwargs: Any,
 ) -> None:
-    """Initialize multi-host JAX (call once per host before building meshes).
+    """Initialize multi-host JAX (call once per process, BEFORE any jax
+    backend use, then build meshes over ``jax.devices()``).
 
     On TPU pods the arguments are auto-detected from the environment, so a
     bare ``init_distributed()`` suffices.
-    """
+
+    Idempotency: ``jax.distributed.initialize`` raises an opaque jaxlib
+    error on a second call ("must be called before any JAX computations"
+    — true but useless when the real cause is double-init). This wrapper
+    makes the second call explicit: a re-call whose arguments agree with
+    the active configuration (or constrain nothing) is a WARNED NO-OP —
+    the idempotent shape library/driver layers need — while a re-call
+    naming a DIFFERENT coordinator/process layout raises a
+    ``RuntimeError`` that says exactly which argument conflicts
+    (tests/test_multihost.py regression-tests both through the
+    ``dryrun_multihost`` harness)."""
+    global _INIT_RECORD
+    requested = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+        **kwargs,
+    }
+    if is_dist_initialized():
+        current = _current_dist_config()
+        conflicts = {
+            name: (req, current[name])
+            for name, req in requested.items()
+            if req is not None
+            and current.get(name) is not None
+            and req != current[name]
+        }
+        if conflicts:
+            detail = ", ".join(
+                f"{k}: requested {req!r} != active {cur!r}"
+                for k, (req, cur) in sorted(conflicts.items())
+            )
+            raise RuntimeError(
+                "init_distributed: jax.distributed is already initialized "
+                f"with a CONFLICTING configuration ({detail}). One process "
+                "belongs to one coordinator for its lifetime — restart the "
+                "process to join a different one."
+            )
+        # arguments whose active value is unknowable (the first init ran
+        # outside this wrapper, or jax's global_state doesn't expose the
+        # field) cannot be verified as matching — say so instead of
+        # claiming a match that was never checked
+        unverified = sorted(
+            name for name, req in requested.items()
+            if req is not None and current.get(name) is None
+        )
+        note = (
+            f" (arguments not verifiable against the active config and "
+            f"IGNORED: {unverified})" if unverified else ""
+        )
+        warnings.warn(
+            "init_distributed: jax.distributed is already initialized "
+            f"(coordinator {current.get('coordinator_address')!r}, "
+            f"{current.get('num_processes')} process(es)); this matching "
+            f"call is a no-op{note}",
+            stacklevel=2,
+        )
+        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         **kwargs,
     )
+    _INIT_RECORD = {k: v for k, v in requested.items() if v is not None}
 
 
 def process_id() -> int:
@@ -656,4 +829,244 @@ def process_count() -> int:
 
 
 def is_dist_initialized() -> bool:
-    return jax.process_count() > 1
+    """True iff ``jax.distributed`` has been initialized in THIS process.
+
+    Reads the distributed-runtime CLIENT, not ``jax.process_count() > 1``:
+    a 1-process ``jax.distributed`` run (a pod job launched at n=1, a
+    coordinator smoke test) is initialized but has one process, and the
+    old count-based predicate misread it as uninitialized
+    (ISSUE 13 satellite; regression-tested via the 1-process leg of the
+    ``dryrun_multihost`` harness). The count check survives only as a
+    last-ditch fallback for jax builds whose ``global_state`` is
+    unreadable — a multi-process device list cannot exist without an
+    initialized runtime. Never touches an UNinitialized backend: probing
+    ``jax.process_count()`` would initialize it, which is precisely what
+    callers checking "may I still init_distributed?" must not do.
+
+    The live client is authoritative whenever introspection works: after
+    ``jax.distributed.shutdown()`` the client is gone and this reads
+    False again (so a re-``init_distributed`` actually re-initializes —
+    the wrapper's own ``_INIT_RECORD`` must never shadow a shutdown)."""
+    client = _dist_client()
+    if client is not _INTROSPECT_FAILED:
+        return client is not None
+    # introspection unavailable: fall back to what THIS wrapper did,
+    # then to the (backend-safe) process count
+    if _INIT_RECORD is not None:  # pragma: no cover - exotic jax builds
+        return True
+    try:  # pragma: no cover - exotic jax builds
+        from jax._src import xla_bridge as _xb
+
+        backend_up = bool(getattr(_xb, "_backends", None))
+    except Exception:
+        backend_up = True
+    return backend_up and jax.process_count() > 1  # pragma: no cover
+
+
+def pod_devices() -> list:
+    """The global device list in POD ORDER: sorted by ``(process_index,
+    id)`` so each process's local devices form one contiguous block —
+    the device order `create_pod_mesh` lays axes over."""
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+
+
+def create_pod_mesh(
+    axis_names: Sequence[str] = (POP_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a global mesh spanning every process's devices.
+
+    The multi-host twin of :func:`create_mesh`: devices come from
+    ``jax.devices()`` (the GLOBAL list once ``init_distributed`` ran on
+    every process) sorted into pod order, so with the default C-order
+    reshape each process's local devices occupy a contiguous block of the
+    LEADING axis — a ``P("pop")``-sharded array then stores each
+    process's population slice on that process, and the (TENANT, POP)
+    2-D fleet mesh (``axis_names=(TENANT_AXIS, POP_AXIS), shape=(t,
+    p)``) keeps whole tenant rows process-local whenever ``t`` is a
+    multiple of the process count. Single-process it degenerates to
+    exactly :func:`create_mesh`. Validates that every process
+    contributes the same device count (jax requires symmetric
+    processes) and that the mesh consumes the whole pod."""
+    if devices is None:
+        devices = pod_devices()
+    devices = list(devices)
+    n = len(devices)
+    counts = {}
+    for d in devices:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    if len(set(counts.values())) > 1:
+        raise ValueError(
+            "create_pod_mesh: processes contribute unequal device counts "
+            f"({counts}); a pod mesh needs symmetric processes"
+        )
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    total = 1
+    for s in shape:
+        total *= int(s)
+    if total != n:
+        raise ValueError(
+            f"create_pod_mesh: shape {tuple(shape)} does not consume the "
+            f"{n} pod devices"
+        )
+    return Mesh(np.asarray(devices, dtype=object).reshape(shape), axis_names)
+
+
+def mesh_spans_processes(mesh: Optional[Mesh]) -> bool:
+    """True when ``mesh`` places devices of more than one process — the
+    gate for the eager global-assembly paths below (a single-process mesh
+    keeps the plain ``device_put`` fast path)."""
+    if mesh is None:
+        return False
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+_BARRIER_SEQ = [0]
+
+
+def process_barrier(name: Optional[str] = None, timeout_s: float = 120.0) -> None:
+    """Block until every process reached this barrier.
+
+    Rides the coordinator's KV store (``wait_at_barrier``), NOT an XLA
+    collective — so it works during startup, between dispatches, and on
+    backends that cannot run a cross-process computation at all. No-op
+    single-process. SPMD discipline applies: every process must call the
+    same barriers in the same order (auto-generated names are a per-
+    process counter). The checkpoint commit protocol is the canonical
+    user: non-zero processes must not proceed past a save point before
+    process 0's manifest is durable."""
+    client = _dist_client()
+    if client is None or jax.process_count() <= 1:
+        return
+    if name is None:
+        _BARRIER_SEQ[0] += 1
+        name = f"evox_tpu_barrier_{_BARRIER_SEQ[0]}"
+    client.wait_at_barrier(name, int(timeout_s * 1000))
+
+
+def _is_typed_key(x: Any) -> bool:
+    dt = getattr(x, "dtype", None)
+    return dt is not None and jnp.issubdtype(dt, jax.dtypes.prng_key)
+
+
+def assemble_global_array(host_arr: Any, sharding: NamedSharding) -> jax.Array:
+    """Build a GLOBAL ``jax.Array`` on ``sharding`` from a full host
+    value every process holds (deterministic init, restored snapshot):
+    each process ``device_put``s only the index slices its own devices
+    own and stitches them with
+    ``jax.make_array_from_single_device_arrays`` — the per-process
+    assembly step a cross-process sharding requires (an eager
+    ``device_put`` onto it is not addressable-complete and raises).
+    Single-process shardings take the plain ``device_put`` fast path."""
+    if not mesh_spans_processes(getattr(sharding, "mesh", None)):
+        return jax.device_put(host_arr, sharding)
+    arr = np.asarray(host_arr)
+    shards = [
+        jax.device_put(arr[idx], d)
+        for d, idx in sharding.addressable_devices_indices_map(
+            arr.shape
+        ).items()
+    ]
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, shards
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _replicate_program(sharding: NamedSharding):
+    """One cached jitted identity-with-allgather per target sharding: a
+    fresh ``jax.jit(lambda ...)`` per call would defeat the dispatch
+    cache and recompile the gather for every leaf of every pod
+    checkpoint/fetch (NamedSharding hashes by (mesh, spec), so the
+    steady-state hot path hits this cache)."""
+    return jax.jit(lambda a: a, out_shardings=sharding)
+
+
+def host_value(x: Any) -> Any:
+    """The FULL host (numpy) value of ``x``, even when it is sharded
+    across processes: fully-addressable arrays are a plain
+    ``device_get``; a cross-process-sharded array is first replicated
+    through a jitted identity (``out_shardings=P()`` — GSPMD inserts the
+    all-gather) and read from the local replica. Every process receives
+    the same value and every process must call this collectively for
+    cross-process operands (it dispatches a computation there)."""
+    if not isinstance(x, jax.Array):
+        return np.asarray(x)
+    if x.is_fully_addressable:
+        return np.asarray(jax.device_get(x))
+    if getattr(x, "is_fully_replicated", False):
+        # replicated global array: the local replica IS the value — no
+        # collective needed (the common case for every strategy-state
+        # scalar in a pod checkpoint gather)
+        return np.asarray(jax.device_get(x.addressable_data(0)))
+    sharding = x.sharding
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:  # pragma: no cover - non-named cross-process layout
+        raise ValueError(
+            "host_value: cannot all-gather a cross-process array without "
+            "a named-sharding mesh"
+        )
+    rep = _replicate_program(NamedSharding(mesh, P()))(x)
+    return np.asarray(jax.device_get(rep.addressable_data(0)))
+
+
+def tree_host_value(tree: Any) -> Any:
+    """:func:`host_value` over a pytree (typed PRNG-key leaves pass
+    through ``key_data`` and come back typed)."""
+
+    def fetch(leaf):
+        if _is_typed_key(leaf):
+            return jax.random.wrap_key_data(
+                jnp.asarray(host_value(jax.random.key_data(leaf))),
+                impl=jax.random.key_impl(leaf),
+            )
+        return host_value(leaf)
+
+    return jax.tree.map(fetch, tree)
+
+
+def ensure_global_state(
+    state: Any,
+    mesh: Optional[Mesh],
+    default: Optional["P"] = None,
+    rules: Optional[Sequence[Tuple[str, "P"]]] = None,
+    axis_prefix: Optional[str] = None,
+) -> Any:
+    """Per-process GLOBAL-state assembly: place every leaf of an
+    eagerly-built (process-local) state onto its annotation-resolved
+    sharding over a pod mesh via :func:`assemble_global_array`, so the
+    state a global-mesh jit consumes is made of global arrays on every
+    process. This is the init/restore boundary of multi-process runs —
+    ``StdWorkflow.init`` et al. call it after their eager ``init`` (which
+    computes the same host value on every process from the same key), and
+    ``place_state`` routes restored snapshots through it.
+
+    No-op when ``mesh`` does not span processes. Leaves that are already
+    global (non-fully-addressable) pass through untouched. Typed PRNG-key
+    leaves are assembled REPLICATED via ``key_data`` (strategy-level
+    keys; a pod layout for key leaves comes from ``constrain_state``
+    inside the step)."""
+    if not mesh_spans_processes(mesh):
+        return state
+    shardings = state_sharding(
+        state, mesh, default=default, rules=rules, axis_prefix=axis_prefix
+    )
+
+    def place(leaf, sh):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return leaf  # already a global array
+        if _is_typed_key(leaf):
+            data = assemble_global_array(
+                np.asarray(jax.device_get(jax.random.key_data(leaf))),
+                NamedSharding(mesh, P()),
+            )
+            return jax.random.wrap_key_data(
+                data, impl=jax.random.key_impl(leaf)
+            )
+        return assemble_global_array(
+            np.asarray(jax.device_get(leaf)), sh
+        )
+
+    return jax.tree.map(place, state, shardings)

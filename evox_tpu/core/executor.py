@@ -703,6 +703,16 @@ class GenerationExecutor:
     def _submit_checkpoint(self, lane: _IoLane, ckpt: Any, state: Any) -> None:
         self.counters["bg_checkpoint"] += 1
         t0 = self._clock()
+        if jax.process_count() > 1:
+            # pod meshes: WorkflowCheckpointer.save gathers collectively
+            # and barriers across processes — both must run in SPMD
+            # lockstep on the admitting thread, never interleaved from a
+            # background lane (each process's lanes drain independently,
+            # which would reorder the collectives and deadlock the pod)
+            ckpt.save(state)
+            self._span("io:checkpoint", "save", t0, self._clock() - t0,
+                       generation=int(state.generation))
+            return
 
         def save():
             ckpt.save(state)
@@ -888,6 +898,19 @@ class GenerationExecutor:
         self.counters["bg_fetch"] += 1
         gen = int(state.generation)
         monitors = state.monitors
+        cross_process = any(
+            isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+            for leaf in jax.tree_util.tree_leaves(monitors)
+        )
+        if cross_process:
+            # pod meshes: the ring all-gather is a COLLECTIVE and must be
+            # dispatched in SPMD lockstep on every process — run it here
+            # on the admitting thread (a background thread interleaving
+            # its own collectives with the main loop's dispatches would
+            # deadlock the pod); only the host bookkeeping rides the lane
+            from .distributed import tree_host_value
+
+            monitors = tree_host_value(monitors)
 
         def fetch():
             t0 = self._clock()

@@ -533,6 +533,85 @@ def _sharding_subsection(
     return None
 
 
+def _multihost_subsection(
+    workflow: Any, state: Any, analyses: Dict[str, dict]
+) -> Optional[dict]:
+    """The roofline ``multihost`` subsection (schema v8, ISSUE 13):
+    attached when THIS process is part of a multi-process
+    ``jax.distributed`` run. Cites the per-PROCESS peak (``memory_
+    analysis`` reports per-device stats for SPMD programs — PR 10 — so a
+    process's peak is its local devices' sum), the full-population
+    artifact bytes it must stay gather-free against per device, and a
+    collective-bytes-per-generation estimate over the ``cost_analysis``
+    shapes: the pop-sized fitness/rank traffic every sharded tell
+    replicates plus (for the ShardedES protocol) the psum-reduced moment
+    tree, sized via ``eval_shape`` of ``pop_moments``."""
+    if jax.process_count() <= 1:
+        return None
+    algo = getattr(workflow, "algorithm", None)
+    pop = int(getattr(algo, "pop_size", 0) or 0)
+    n_local = jax.local_device_count()
+    peak = entry_used = None
+    for entry in ("step", "run"):
+        analysis = analyses.get(entry)
+        if not isinstance(analysis, dict) or "error" in analysis:
+            continue
+        p = (analysis.get("memory") or {}).get("peak_bytes_estimate")
+        if p:
+            peak, entry_used = int(p), entry
+            break
+    if peak is None:
+        return None
+    full = 0
+    astate = getattr(state, "algo", None)
+    for leaf in jax.tree_util.tree_leaves(astate):
+        shape = getattr(leaf, "shape", ())
+        if pop and len(shape) >= 1 and shape[0] == pop:
+            itemsize = np.dtype(leaf.dtype).itemsize
+            if np.issubdtype(np.dtype(leaf.dtype), np.floating):
+                itemsize = max(itemsize, 4)  # compute-width (PR-10 rule)
+            full += int(np.prod(shape)) * itemsize
+    # collective traffic model per generation: fitness + ranks are
+    # replicated pop-sized operands; the ShardedES tell additionally
+    # psums its (dim,)-sized moment tree
+    collective = 2 * pop * 4
+    if getattr(algo, "is_pop_sharded", False):
+        try:
+            inner = getattr(algo, "algorithm", algo)
+            shard = pop // max(int(getattr(algo, "n_shards", 1) or 1), 1)
+            rows = {
+                name: jax.ShapeDtypeStruct(
+                    getattr(astate, name).shape[:0]
+                    + (shard,)
+                    + getattr(astate, name).shape[1:],
+                    jnp.float32,
+                )
+                for name in getattr(inner, "sharded_pop_fields", ())
+            }
+            w_sds = jax.ShapeDtypeStruct((shard,), jnp.float32)
+            moments = jax.eval_shape(inner.pop_moments, rows, w_sds)
+            collective += sum(
+                int(np.prod(m.shape)) * 4
+                for m in jax.tree_util.tree_leaves(moments)
+            )
+        except Exception:
+            pass  # the base fitness/rank model stands
+    return {
+        "process_count": int(jax.process_count()),
+        "n_local_devices": int(n_local),
+        "entry": entry_used,
+        "per_device_peak_bytes": peak,
+        "per_process_peak_bytes": peak * int(n_local),
+        "full_pop_bytes": int(full),
+        "collective_bytes_estimate": int(collective),
+        "collective_model": (
+            "2*pop*4 fitness/rank replication + psum moment tree "
+            "(eval_shape over pop_moments); per-process peak = "
+            "per-device peak * local device count"
+        ),
+    }
+
+
 def run_report(
     workflow: Any = None,
     state: Any = None,
@@ -583,8 +662,11 @@ def run_report(
     # the optional `serving` section (core/exec_cache.py +
     # workflows/elastic.py): the AOT executable cache's hit/miss/compile
     # accounting (`serving.cache`) and the bucket lattice the workflow
-    # serves (`serving.buckets`) — validated when present.
-    report: dict = {"schema": "evox_tpu.run_report/v7"}
+    # serves (`serving.buckets`) — validated when present. v8 adds the
+    # optional roofline `multihost` subsection (ISSUE 13: multi-process
+    # runs cite their per-process AOT peak and a collective-bytes
+    # estimate next to the sharding evidence) — validated when present.
+    report: dict = {"schema": "evox_tpu.run_report/v8"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -676,6 +758,13 @@ def run_report(
             )
             if sharding is not None:
                 report["roofline"]["sharding"] = sharding
+            # multi-process provenance (schema v8, ISSUE 13): a pod run
+            # cites its per-process peak + collective-traffic estimate
+            multihost = _multihost_subsection(
+                workflow, state, analyzer.analyses
+            )
+            if multihost is not None:
+                report["roofline"]["multihost"] = multihost
     # elastic serving (schema v7, duck-typed — core never imports the
     # workflows package): a bucket workflow warmed through the AOT
     # executable cache advertises it as `_exec_cache`

@@ -13,7 +13,9 @@ from .tenancy import (
     TenantSpec,
     VectorizedWorkflow,
     VectorizedWorkflowState,
+    bind_hyperparams,
 )
+from .multilevel import HyperSpec, MultiLevelES, MultiLevelState
 from .elastic import (
     BucketError,
     BucketShape,
@@ -38,6 +40,10 @@ __all__ = [
     "IslandWorkflowState",
     "VectorizedWorkflow",
     "VectorizedWorkflowState",
+    "bind_hyperparams",
+    "HyperSpec",
+    "MultiLevelES",
+    "MultiLevelState",
     "RunQueue",
     "TenantSpec",
     "BucketError",

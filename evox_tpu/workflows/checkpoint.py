@@ -30,11 +30,22 @@ Durability contract:
   overrides.
 
 Topology portability: snapshot leaves are plain host numpy arrays
-(``jax.device_get`` gathers every shard), so a snapshot carries NO mesh
-— the manifest records the save-time topology and per-leaf sharding
-specs for provenance only. Restoring onto a *different* device count
-(the realistic TPU device-loss recovery path: checkpoint on 8 chips,
-restart on 4 or 1) is therefore data-complete by construction;
+(``jax.device_get`` gathers every shard; cross-process-sharded leaves
+all-gather through ``core.distributed.host_value`` first), so a snapshot
+carries NO mesh — the manifest records the save-time topology (device
+AND process counts) and per-leaf sharding specs for provenance only.
+Restoring onto a *different* device count OR PROCESS count (checkpoint
+on 8 devices in 1 process, restart as 2×4 or 4×2 processes — the pod
+recovery path; ``place_state`` reassembles each process's addressable
+shards from the host leaves) is therefore data-complete by construction.
+Pod saves follow process-0-writes + barrier discipline: the gather is
+collective, process 0 writes the one manifest, a coordinator-KV barrier
+holds the others until it is durable — one pod save is one manifest,
+not N (see :meth:`WorkflowCheckpointer.save`). Restoring on a pod reads
+the snapshot on every process (shared or replicated filesystem) and
+reassembles; the dryrun_multihost harness asserts the 1-process→
+n-process trajectory-reproduction law where the backend can run
+cross-process collectives.
 :func:`restore_layouts` (or ``StdWorkflow.resume(state_sharding=...)``)
 eagerly re-places the host leaves onto the CURRENT mesh according to the
 state's own ``field(sharding=...)`` annotations — the same layout law
@@ -224,12 +235,37 @@ class WorkflowCheckpointer:
         fsync, then its ``.manifest.json`` (schema, generation, byte
         count, SHA-256, config fingerprint, save-time topology) the same
         way — the manifest is the commit record, so a torn data file can
-        never masquerade as a valid snapshot."""
+        never masquerade as a valid snapshot.
+
+        Multi-process (pod) discipline: every process participates in the
+        device→host gather (cross-process-sharded leaves all-gather
+        through :func:`~evox_tpu.core.distributed.host_value` — a
+        collective, so ``save`` must be called on EVERY process, the SPMD
+        law every dispatch already obeys), but only PROCESS 0 writes —
+        one pod save is ONE manifest, not N racing copies — and a KV-
+        store barrier holds the others until the manifest is durable, so
+        no process can run ahead of a commit it may later restore. The
+        snapshot itself stays topology-free host data: a 1-process save
+        resumes on any process count and vice versa (``place_state``
+        reassembles per-process shards on the restoring pod's mesh)."""
+        multiproc = jax.process_count() > 1
         shardings = _leaf_shardings(state)
-        host_state = jax.device_get(state)
-        payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
+        if multiproc:
+            from ..core.distributed import process_barrier, tree_host_value
+
+            # collective all-gather: every process ends with the FULL
+            # host value of every leaf (identical bytes on each process)
+            host_state = tree_host_value(state)
+        else:
+            host_state = jax.device_get(state)
         gen = int(host_state.generation)
         path = self.directory / f"ckpt_{gen:08d}.pkl"
+        if multiproc and jax.process_index() != 0:
+            # process-0-writes: wait for the writer's manifest commit
+            # (save() below hits the same barrier after its writes)
+            process_barrier()
+            return path
+        payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
         _write_durable(path, payload, ".pkl.tmp")
         # a kill here (data durable, manifest not) must leave latest()
         # on the PREVIOUS intact snapshot — the manifest is the commit
@@ -260,6 +296,12 @@ class WorkflowCheckpointer:
         )
         self._write_config()
         self._prune()
+        if multiproc:
+            from ..core.distributed import process_barrier
+
+            # release the non-writer processes only after the manifest
+            # (the commit record) is durable on disk
+            process_barrier()
         return path
 
     def maybe_save(self, state: Any) -> Optional[Path]:

@@ -141,6 +141,23 @@ class IslandWorkflow:
         self.pop_transforms = tuple(pop_transforms)
         self.mesh = mesh
         self.external = (not problem.jittable) if external_problem is None else external_problem
+        if self.external and mesh is not None:
+            from ..core.distributed import mesh_spans_processes
+
+            if mesh_spans_processes(mesh):
+                # same refusal (and reason) as StdWorkflow: a
+                # pure_callback under a PROCESS-SPANNING mesh would run
+                # the host evaluate on every process against
+                # unsynchronized host problem state; a process-local
+                # mesh in a multi-process run stays legal
+                raise ValueError(
+                    "external (host) problems are single-process: under "
+                    "multi-process SPMD each process would invoke the "
+                    "host evaluate on its own shard against "
+                    "unsynchronized host state. Use a jittable problem "
+                    "for pod-mesh islands, or run islands on a "
+                    "process-local mesh."
+                )
         if mesh is not None:
             n_shards = mesh.shape[_POP_AXIS_NAME]
             if n_islands % n_shards != 0:
@@ -161,10 +178,15 @@ class IslandWorkflow:
 
     # ------------------------------------------------------------------ init
     def init(self, key: jax.Array) -> IslandWorkflowState:
+        from ..core.distributed import ensure_global_state, mesh_spans_processes
+
         keys = jax.random.split(key, 2 + len(self.monitors))
         island_keys = jax.random.split(keys[1], self.n_islands)
         algo = jax.vmap(self.algorithm.init)(island_keys)
-        algo = self._constrain(algo)
+        if not mesh_spans_processes(self.mesh):
+            # an eager sharding constraint cannot target a cross-process
+            # layout; the pod path lays out via ensure_global_state below
+            algo = self._constrain(algo)
         state = IslandWorkflowState(
             generation=jnp.zeros((), dtype=jnp.int32),
             algo=algo,
@@ -174,7 +196,14 @@ class IslandWorkflow:
         )
         # island-stacked leaves rest at storage width from the start (the
         # field annotations resolve through the extra island axis)
-        return apply_storage(state, self.dtype_policy)
+        state = apply_storage(state, self.dtype_policy)
+        # pod meshes: assemble per-process shards of the island-stacked
+        # leaves (islands shard whole-island over the pop axis, so the
+        # leading-axis rule is the island rule here)
+        return ensure_global_state(
+            state, self.mesh,
+            rules=((r"\.algo\.", jax.sharding.PartitionSpec(_POP_AXIS_NAME)),),
+        )
 
     # ------------------------------------------------------------------ step
     def step(self, state: IslandWorkflowState) -> IslandWorkflowState:

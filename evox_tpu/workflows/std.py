@@ -190,13 +190,16 @@ class StdWorkflow:
             raise ValueError(
                 "eval_shard_map requires a mesh and a jittable problem"
             )
-        if self.external and mesh is not None and jax.process_count() > 1:
+        from ..core.distributed import mesh_spans_processes
+
+        if self.external and mesh_spans_processes(mesh):
             # explicit refusal, not silent corruption: under a mesh that
-            # spans processes, the pure_callback would run problem.evaluate
+            # SPANS processes, the pure_callback would run problem.evaluate
             # on EVERY process against its own population shard and an
             # unsynchronized host-side problem object (reference's Ray path
             # existed precisely to own this; SURVEY §7 "host callbacks").
-            # A mesh-less workflow stays legal multi-controller JAX: each
+            # A mesh-less workflow — or a process-LOCAL mesh in a
+            # multi-process run — stays legal multi-controller JAX: each
             # process owns its whole population locally.
             raise ValueError(
                 "external (host) problems are single-process: under "
@@ -320,7 +323,15 @@ class StdWorkflow:
         )
         # storage-annotated leaves rest in the policy's storage dtype from
         # the very first state, so the step signature never changes
-        return apply_storage(state, self.dtype_policy)
+        state = apply_storage(state, self.dtype_policy)
+        # pod meshes: the eager init above computed identical host values
+        # on every process (same key); assemble them into GLOBAL arrays
+        # (per-process make_array_from_single_device_arrays over the
+        # field-annotation layout) so the global-mesh jit can consume the
+        # state — no-op on single-process meshes (core/distributed.py)
+        from ..core.distributed import ensure_global_state
+
+        return ensure_global_state(state, self.mesh)
 
     # ------------------------------------------------------------------ step
     def step(self, state: StdWorkflowState) -> StdWorkflowState:

@@ -135,6 +135,34 @@ class VectorizedWorkflowState(PyTreeNode):
     first_step: bool = static_field(default=True)
 
 
+def bind_hyperparams(template: Any, hp: Dict[str, Any]) -> Any:
+    """A shallow copy of ``template`` with ``hp``'s (possibly dotted)
+    attribute paths bound as TRACED values — the one hyperparameter-
+    binding law shared by the vmapped tenant fleet (each tenant's slice
+    under vmap) and the multi-level ES's jitted inner halves (each
+    group's proposal as a jit operand). Dotted paths copy-on-write each
+    intermediate object, so a ``GuardedAlgorithm``'s inner algorithm is
+    copied before its attribute is rebound; the template itself is never
+    mutated."""
+    if not hp:
+        return template
+    root = copy.copy(template)
+    fresh: Dict[str, Any] = {}
+    for name, value in hp.items():
+        obj = root
+        parts = name.split(".")
+        for depth, part in enumerate(parts[:-1]):
+            prefix = ".".join(parts[: depth + 1])
+            child = fresh.get(prefix)
+            if child is None:
+                child = copy.copy(getattr(obj, part))
+                fresh[prefix] = child
+                setattr(obj, part, child)
+            obj = child
+        setattr(obj, parts[-1], value)
+    return root
+
+
 def _tenant_keys(key: jax.Array, n: int) -> jax.Array:
     """Accept one key (split per tenant) or an already-stacked (n, ...)
     key batch — the stacked form is how fleet-vs-solo equivalence tests
@@ -318,26 +346,10 @@ class VectorizedWorkflow:
 
     def _bind(self, hp: Dict[str, Any]) -> Algorithm:
         """A shallow copy of the template with this tenant's hyperparam
-        slices bound as attributes (dotted paths copy-on-write each
-        intermediate object, so a ``GuardedAlgorithm``'s inner algorithm
-        is copied before its attribute is rebound)."""
-        if not hp:
-            return self.algorithm
-        root = copy.copy(self.algorithm)
-        fresh: Dict[str, Any] = {}
-        for name, value in hp.items():
-            obj = root
-            parts = name.split(".")
-            for depth, part in enumerate(parts[:-1]):
-                prefix = ".".join(parts[: depth + 1])
-                child = fresh.get(prefix)
-                if child is None:
-                    child = copy.copy(getattr(obj, part))
-                    fresh[prefix] = child
-                    setattr(obj, part, child)
-                obj = child
-            setattr(obj, parts[-1], value)
-        return root
+        slices bound as attributes (:func:`bind_hyperparams` — shared
+        with the multi-level ES's traced inner binding,
+        workflows/multilevel.py)."""
+        return bind_hyperparams(self.algorithm, hp)
 
     def tenant_hyperparams(
         self, index: int, state: Optional[VectorizedWorkflowState] = None
@@ -376,7 +388,15 @@ class VectorizedWorkflow:
             tenants=tenants,
             first_step=True,
         )
-        return apply_storage(state, self.dtype_policy)
+        state = apply_storage(state, self.dtype_policy)
+        # pod meshes: assemble the tenant-stacked state into global
+        # arrays under the tenant-prefixed annotation layout (no-op on
+        # single-process meshes; see core/distributed.ensure_global_state)
+        from ..core.distributed import ensure_global_state
+
+        return ensure_global_state(
+            state, self.mesh, rules=self.rules, axis_prefix=_TENANT
+        )
 
     def _build_tenant(self, k: jax.Array, h: Dict[str, Any]) -> TenantState:
         """The single-tenant constructor shared by the vmapped fleet

@@ -303,3 +303,87 @@ def test_validator_v6_journaled_evict_needs_checkpoint():
     # checkpoint-less evictions are fine on an unjournaled queue
     del report["tenancy"]["queue"]["journal"]
     assert check_report.validate_run_report(report) == []
+
+
+def test_validator_multihost_subsection_rules():
+    """v8 roofline.multihost (ISSUE 13): a well-formed pod section
+    passes; an incoherent per-process/per-device product, a per-device
+    peak at/above full-pop bytes, or missing fields fail."""
+    report = _fresh_report(True)
+    good = json.loads(json.dumps(report))
+    good["roofline"]["multihost"] = {
+        "process_count": 2,
+        "n_local_devices": 4,
+        "entry": "step",
+        "per_device_peak_bytes": 5_000_000,
+        "per_process_peak_bytes": 20_000_000,
+        "full_pop_bytes": 8_388_608,
+        "collective_bytes_estimate": 300_000,
+        "collective_model": "2*pop*4 + psum moment tree",
+    }
+    assert check_report.validate_run_report(good) == []
+    bad = json.loads(json.dumps(good))
+    bad["roofline"]["multihost"]["per_process_peak_bytes"] = 19_999_999
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "per_process_peak_bytes" in errors and "!=" in errors
+    bad2 = json.loads(json.dumps(good))
+    bad2["roofline"]["multihost"]["per_device_peak_bytes"] = 9_000_000
+    bad2["roofline"]["multihost"]["per_process_peak_bytes"] = 36_000_000
+    errors = "\n".join(check_report.validate_run_report(bad2))
+    assert "materializes the full population" in errors
+    bad3 = json.loads(json.dumps(good))
+    del bad3["roofline"]["multihost"]["process_count"]
+    assert any(
+        "multihost.process_count" in e
+        for e in check_report.validate_run_report(bad3)
+    )
+
+
+def test_validator_multihost_bench_rules():
+    """v8 bench rules: a multihost leg must carry its measured
+    vs_baseline + ratio_rounds; a multihost summary key needs the AOT
+    static-bytes referee, and a missing pod-side number needs the
+    provenance note (the large_pop note discipline); a pod peak at or
+    above the solo peak is a scaling claim that bought nothing."""
+    summary = {
+        "metric": "geomean",
+        "value": 1.0,
+        "unit": "x",
+        "sub_metrics": [
+            {
+                "metric": "Multihost sharded SepCMAES evals/sec (2x4 pod)",
+                "value": 1.0e5,
+                "unit": "evals/sec",
+                "vs_baseline": None,
+                "ratio_rounds": None,
+            }
+        ],
+    }
+    errors = "\n".join(check_report.validate_bench(summary))
+    assert "multihost" in errors and "solo-baseline" in errors
+    summary["sub_metrics"][0]["vs_baseline"] = 0.9
+    summary["sub_metrics"][0]["ratio_rounds"] = [0.89, 0.9]
+    assert check_report.validate_bench(summary) == []
+    # summary key: missing table rejected
+    summary["multihost"] = {"collectives_ran": False}
+    errors = "\n".join(check_report.validate_bench(summary))
+    assert "static_bytes missing" in errors
+    # measured pod side must beat the solo side
+    summary["multihost"] = {
+        "static_bytes": {
+            "solo_per_process_peak_bytes": 42_000_000,
+            "pod_per_process_peak_bytes": 43_000_000,
+        }
+    }
+    errors = "\n".join(check_report.validate_bench(summary))
+    assert "bought no per-process memory" in errors
+    # absent pod side needs the note/skip_reason
+    summary["multihost"] = {
+        "static_bytes": {"solo_per_process_peak_bytes": 42_000_000}
+    }
+    errors = "\n".join(check_report.validate_bench(summary))
+    assert "unmeasured" in errors
+    summary["multihost"]["skip_reason"] = (
+        "CPU backend cannot run multiprocess collectives on jaxlib 0.4.36"
+    )
+    assert check_report.validate_bench(summary) == []

@@ -260,3 +260,17 @@ def test_elastic_serving_modules_are_callback_free():
     for rel in ("core/exec_cache.py", "workflows/elastic.py"):
         assert (PKG / rel).exists(), f"{rel} missing"
         assert rel not in users, f"{rel} must not use host callbacks"
+
+
+def test_multihost_modules_are_callback_free():
+    """The ISSUE-13 multi-host layer must hold the axon constraint by
+    construction: pod-mesh construction / global-array assembly /
+    host_value all-gathers (core/distributed.py) are eager host-side
+    orchestration or plain jitted identities, and the multi-level ES
+    (workflows/multilevel.py) drives its inner phases entirely between
+    dispatches — a host callback in either would make multi-process runs
+    (or the multilevel workload) unusable on the tunneled TPU."""
+    users = _scan()
+    for rel in ("core/distributed.py", "workflows/multilevel.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"

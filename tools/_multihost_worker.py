@@ -1,0 +1,372 @@
+"""One process of the ``dryrun_multihost(n)`` harness (__graft_entry__.py).
+
+Launched ``n`` times (plus once solo at ``nprocs=1`` as the 1-process
+reference leg) with a JSON spec on argv[1]. Each worker:
+
+1. BEFORE any jax backend touch: loads ``evox_tpu/core/distributed.py``
+   standalone (importing the package would build jnp constants and
+   initialize the backend ahead of ``jax.distributed`` — the same loader
+   discipline the pre-PR-13 multiprocess test used) and runs the
+   ``init_distributed`` guard laws: the ``is_dist_initialized`` fix (a
+   1-process ``jax.distributed`` run MUST read as initialized — the old
+   ``process_count() > 1`` predicate misread it), the warned no-op on a
+   matching re-init, and the loud ``RuntimeError`` on a conflicting one.
+2. Imports evox_tpu, builds the pod mesh over the global device list,
+   and asserts the Tier-A membership laws (works on ANY jaxlib): global
+   device discovery, process-contiguous mesh order, per-process
+   ``make_array_from_single_device_arrays`` assembly, and the
+   external-problem refusal under a process-spanning mesh.
+3. Where the backend can run cross-process computations (jaxlib >= 0.5;
+   the CPU backend below that refuses at COMPILE time with
+   "Multiprocess computations aren't implemented"), runs the Tier-B
+   collective laws: ShardedES sharded ≡ replicated across process
+   boundaries, the 1-process → n-process checkpoint-resume trajectory
+   law, process-0-only monitor-callback pinning, the pod save
+   (process-0-writes + barrier, one manifest), and the AOT per-process
+   memory table.
+
+Results land as ``result_<tag>.json`` in the shared workdir; the parent
+(`dryrun_multihost`) aggregates and asserts. Never import this module —
+it is a subprocess entry point only.
+"""
+
+import json
+import os
+import sys
+import warnings
+
+
+def main() -> None:
+    spec = json.loads(sys.argv[1])
+    pid = int(spec["pid"])
+    nprocs = int(spec["nprocs"])
+    n_local = int(spec["n_local"])
+    workdir = spec["workdir"]
+    repo = spec["repo"]
+    tag = spec.get("tag", f"{nprocs}x{n_local}_p{pid}")
+    result = {
+        "pid": pid,
+        "nprocs": nprocs,
+        "n_local": n_local,
+        "tag": tag,
+        "laws": {},
+        "collectives": {},
+    }
+
+    # --- phase 0: environment, BEFORE importing jax -----------------------
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={n_local}"
+    )
+    sys.path.insert(0, repo)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    # --- phase 1: pre-backend init + guard laws (file-loaded module) ------
+    import importlib.util
+
+    dist_py = os.path.join(repo, "evox_tpu", "core", "distributed.py")
+    loader_spec = importlib.util.spec_from_file_location(
+        "evox_tpu_distributed_standalone", dist_py
+    )
+    D = importlib.util.module_from_spec(loader_spec)
+    loader_spec.loader.exec_module(D)
+
+    assert not D.is_dist_initialized(), "fresh process reads initialized"
+    coord = f"127.0.0.1:{spec['port']}"
+    D.init_distributed(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid
+    )
+    # THE satellite regression: a 1-process jax.distributed run is
+    # initialized — the old `process_count() > 1` predicate said False
+    assert D.is_dist_initialized(), (
+        f"is_dist_initialized() False after init (nprocs={nprocs})"
+    )
+    result["laws"]["is_dist_initialized"] = "ok"
+
+    # idempotent re-call: warned no-op
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        D.init_distributed(
+            coordinator_address=coord, num_processes=nprocs, process_id=pid
+        )
+    assert any("already initialized" in str(w.message) for w in caught), (
+        "matching re-init did not warn"
+    )
+    # constraint-free re-call (the auto-detect shape): also a warned no-op
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        D.init_distributed()
+    assert any("no-op" in str(w.message) for w in caught)
+    # conflicting re-call: loud RuntimeError naming the conflict
+    try:
+        D.init_distributed(
+            coordinator_address="127.0.0.1:1", num_processes=nprocs,
+            process_id=pid,
+        )
+        raise SystemExit("conflicting re-init did not raise")
+    except RuntimeError as e:
+        assert "coordinator_address" in str(e), e
+    result["laws"]["init_guard"] = "ok"
+
+    assert D.process_count() == nprocs, D.process_count()
+    assert D.process_id() == pid
+
+    # --- phase 2: package import + Tier-A membership laws -----------------
+    import numpy as np
+
+    import evox_tpu  # noqa: F401  (backend initializes under jax.distributed)
+    from evox_tpu.core import distributed as dist
+
+    n_total = nprocs * n_local
+    assert jax.device_count() == n_total, (jax.device_count(), n_total)
+    assert jax.local_device_count() == n_local
+
+    mesh = dist.create_pod_mesh()
+    assert int(mesh.shape[dist.POP_AXIS]) == n_total
+    # process contiguity: block k of the leading axis belongs to process k
+    flat = list(mesh.devices.flat)
+    for k in range(nprocs):
+        block = flat[k * n_local : (k + 1) * n_local]
+        assert all(d.process_index == k for d in block), (
+            "pod mesh is not process-contiguous"
+        )
+    result["laws"]["pod_mesh"] = "ok"
+
+    # per-process assembly: every process holds the full host value, puts
+    # only its own slices, and the global array's local shards are exactly
+    # the process's block of the leading axis
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = np.arange(4 * n_total, dtype=np.float32).reshape(n_total, 4)
+    g = dist.assemble_global_array(x, NamedSharding(mesh, P(dist.POP_AXIS)))
+    for shard in g.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data), x[shard.index])
+        assert shard.device.process_index == pid
+    result["laws"]["assembly"] = "ok"
+
+    # external (host) problems refuse a process-spanning mesh AT
+    # CONSTRUCTION (no compile involved — Tier A even on jaxlib < 0.5)
+    if nprocs > 1:
+        import jax.numpy as jnp
+
+        from evox_tpu import StdWorkflow
+        from evox_tpu.core.problem import Problem
+
+        class HostSphere(Problem):
+            jittable = False
+
+            def evaluate(self, state, pop):
+                return np.sum(np.asarray(pop) ** 2, axis=1), state
+
+        algo = _pso(jnp)
+        try:
+            StdWorkflow(algo, HostSphere(), mesh=mesh)
+            raise SystemExit("external problem was not refused on pod mesh")
+        except ValueError as e:
+            assert "single-process" in str(e), e
+        result["laws"]["external_refusal"] = "ok"
+
+    # --- phase 3: Tier B (cross-process computations) ---------------------
+    if spec.get("collectives", False) or nprocs == 1:
+        _collective_laws(spec, result, dist, mesh, nprocs, n_local, workdir)
+    else:
+        result["collectives"]["skipped"] = spec.get(
+            "skip_reason", "collectives disabled"
+        )
+
+    _dump(result, workdir, tag)
+    print(f"WORKER {tag} OK", flush=True)
+
+
+def _pso(jnp):
+    from evox_tpu.algorithms.so.pso import PSO
+
+    return PSO(lb=-5.0 * jnp.ones(4), ub=5.0 * jnp.ones(4), pop_size=8)
+
+
+def _law_workflow(mesh, n_shards, pop=32, dim=16):
+    """The law workload: POP-sharded ShardedES(SepCMAES) on Sphere —
+    per-shard fold_in sampling + psum-of-moments recombination, the PR-10
+    substrate now spanning processes."""
+    import jax.numpy as jnp
+
+    from evox_tpu import ShardedES, StdWorkflow
+    from evox_tpu.algorithms.so.es import SepCMAES
+    from evox_tpu.problems.numerical import Sphere
+
+    algo = ShardedES(
+        SepCMAES(center_init=jnp.zeros(dim), init_stdev=1.0, pop_size=pop),
+        mesh=mesh,
+        n_shards=n_shards,
+    )
+    return StdWorkflow(algo, Sphere(), mesh=mesh)
+
+
+def _collective_laws(spec, result, dist, mesh, nprocs, n_local, workdir):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n_total = nprocs * n_local
+    gens_snapshot, gens_total = 3, 6
+
+    # (a) sharded ≡ replicated across process boundaries: the pod-mesh
+    # ShardedES run must match the SAME per-shard sampling law executed
+    # replicated in-process (mesh=None, n_shards=n_total — no collectives,
+    # identical on every process since the key is identical)
+    wf = _law_workflow(mesh, n_total)
+    state = wf.init(jax.random.PRNGKey(7))
+    for _ in range(gens_total):
+        state = wf.step(state)
+    mean_sh = dist.host_value(state.algo.mean)
+    sigma_sh = float(dist.host_value(state.algo.sigma))
+    wf_rep = _law_workflow(None, n_total)
+    state_rep = wf_rep.init(jax.random.PRNGKey(7))
+    for _ in range(gens_total):
+        state_rep = wf_rep.step(state_rep)
+    np.testing.assert_allclose(
+        mean_sh, np.asarray(state_rep.algo.mean), rtol=1e-5, atol=1e-5,
+        err_msg="pod-sharded ShardedES diverged from the replicated law",
+    )
+    np.testing.assert_allclose(
+        sigma_sh, float(state_rep.algo.sigma), rtol=1e-5, atol=1e-5
+    )
+    result["collectives"]["sharded_vs_replicated"] = "ok"
+
+    # (b) checkpoint topology portability across PROCESS counts
+    from evox_tpu.workflows.checkpoint import (
+        WorkflowCheckpointer, restore_layouts,
+    )
+
+    ckpt_dir = os.path.join(workdir, "solo_ckpt")
+    if nprocs == 1:
+        # the reference leg: 1-process run over ALL devices, snapshot at
+        # gens_snapshot, straight finish recorded for the pod legs
+        ckpt = WorkflowCheckpointer(ckpt_dir, every=gens_snapshot, keep=10)
+        st = wf.init(jax.random.PRNGKey(11))
+        for g in range(gens_total):
+            st = wf.step(st)
+            ckpt.maybe_save(st)
+        result["final"] = {
+            "mean": np.asarray(dist.host_value(st.algo.mean)).tolist(),
+            "sigma": float(dist.host_value(st.algo.sigma)),
+            "generation": int(st.generation),
+        }
+    else:
+        # pod leg: resume the 1-process gen-K snapshot on THIS process
+        # layout and reproduce the solo trajectory's remaining stretch
+        ckpt = WorkflowCheckpointer(ckpt_dir, every=gens_snapshot, keep=10)
+        expect = jax.eval_shape(wf.init, jax.random.PRNGKey(0))
+        snap = ckpt.load(gens_snapshot, expect_like=expect)
+        assert snap is not None, "1-process snapshot missing"
+        st = restore_layouts(snap, mesh=mesh)
+        for _ in range(gens_total - gens_snapshot):
+            st = wf.step(st)
+        solo = json.load(
+            open(os.path.join(workdir, "result_solo.json"))
+        )["final"]
+        np.testing.assert_allclose(
+            np.asarray(dist.host_value(st.algo.mean)),
+            np.asarray(solo["mean"], dtype=np.float32),
+            rtol=1e-5, atol=1e-5,
+            err_msg="1-process snapshot resumed on the pod diverged",
+        )
+        result["collectives"]["resume_1_to_n"] = "ok"
+
+        # (c) pod save: process-0-writes + barrier — ONE manifest
+        pod_dir = os.path.join(workdir, f"pod_ckpt_{nprocs}x{n_local}")
+        pod_ckpt = WorkflowCheckpointer(pod_dir, every=1, keep=3)
+        pod_ckpt.save(st)
+        manifests = [
+            f for f in os.listdir(pod_dir) if f.endswith(".manifest.json")
+        ]
+        assert len(manifests) == 1, manifests
+        if jax.process_index() == 0:
+            back = pod_ckpt.latest(expect_like=st)
+            assert back is not None
+            np.testing.assert_allclose(
+                np.asarray(back.algo.mean),
+                np.asarray(dist.host_value(st.algo.mean)),
+                rtol=0, atol=0,
+            )
+        result["collectives"]["pod_save"] = "ok"
+
+        # (d) monitor io_callback pinning: history fires on process 0 only
+        from evox_tpu import StdWorkflow
+        from evox_tpu.monitors import EvalMonitor
+        from evox_tpu.problems.numerical import Sphere
+
+        mon = EvalMonitor(full_fit_history=True)
+        mwf = StdWorkflow(_pso(jnp), Sphere(), monitors=[mon], mesh=mesh)
+        mstate = mwf.init(jax.random.PRNGKey(0))
+        for _ in range(3):
+            mstate = mwf.step(mstate)
+        jax.effects_barrier()
+        n_hist = len(mon.get_fitness_history())
+        expected = 3 if jax.process_index() == 0 else 0
+        assert n_hist == expected, (jax.process_index(), n_hist, expected)
+        result["collectives"]["monitor_process0_pinning"] = "ok"
+
+    # (e) AOT per-process memory table at the acceptance shape
+    mem_pop, mem_dim = spec.get("mem_shape", (32768, 64))
+    try:
+        from evox_tpu.core.xla_cost import analyze_callable
+
+        mwf = _law_workflow(mesh, n_total, pop=mem_pop, dim=mem_dim)
+        sds = jax.eval_shape(mwf.init, jax.random.PRNGKey(0))
+        sds = sds.replace(first_step=False)
+        mem = analyze_callable(mwf._step, sds).get("memory") or {}
+        peak = mem.get("peak_bytes_estimate")
+        if peak:
+            result["memory"] = {
+                "pop": mem_pop,
+                "dim": mem_dim,
+                "per_device_peak_bytes": int(peak),
+                # memory_analysis reports per-device stats for SPMD
+                # programs (PR-10 precedent); a process's peak is its
+                # local devices' sum
+                "per_process_peak_bytes": int(peak) * n_local,
+                "n_local": n_local,
+                "full_pop_bytes": mem_pop * mem_dim * 4,
+            }
+    except Exception as e:  # the table must never sink the laws
+        result["memory"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # optional bench leg: differenced fused-run slope at the bench shape
+    pair = spec.get("bench_pair")
+    if pair:
+        import time
+
+        bpop, bdim = spec.get("bench_shape", (4096, 32))
+        bwf = _law_workflow(mesh, n_total, pop=bpop, dim=bdim)
+        bst = bwf.init(jax.random.PRNGKey(21))
+        bst = bwf.run(bst, pair[0])  # compile + warm
+
+        def timed(n):
+            nonlocal bst
+            t0 = time.perf_counter()
+            bst = bwf.run(bst, n)
+            float(dist.host_value(bst.algo.sigma))  # small-leaf fetch
+            return time.perf_counter() - t0
+
+        t1, t2 = timed(pair[0]), timed(pair[1])
+        result["bench"] = {
+            "pair": list(pair),
+            "slope_s_per_gen": (t2 - t1) / (pair[1] - pair[0]),
+            "pop": bpop,
+            "dim": bdim,
+        }
+
+
+def _dump(result, workdir, tag):
+    path = os.path.join(workdir, f"result_{tag}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(path + ".tmp", path)
+
+
+if __name__ == "__main__":
+    main()

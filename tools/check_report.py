@@ -240,6 +240,13 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                 shd = roofline.get("sharding")
                 if shd is not None:
                     errors += _validate_sharding(shd, where)
+                # ISSUE-13 (schema v8+): multi-process runs carry a
+                # `multihost` subsection citing the per-process AOT peak
+                # and the collective-traffic estimate. Optional:
+                # single-process runs don't carry it.
+                mh = roofline.get("multihost")
+                if mh is not None:
+                    errors += _validate_multihost(mh, where)
                 don = roofline.get("donation")
                 if schema_version < 2:
                     pass
@@ -308,6 +315,60 @@ def _validate_sharding(shd: Any, where: str) -> List[str]:
             f"{where}: roofline.sharding.gather_free is not true — a "
             "sharded run whose own report denies the gather-free property "
             "must not ship"
+        )
+    return errors
+
+
+def _validate_multihost(mh: Any, where: str) -> List[str]:
+    """The roofline ``multihost`` subsection (schema v8, ISSUE 13): a
+    multi-process run's per-process AOT peak and collective-bytes
+    estimate. Coherence rules: per-process peak = per-device peak ×
+    local device count (memory_analysis is per-device for SPMD
+    programs), and the per-DEVICE peak must stay below the full-pop
+    artifact bytes — a pod program that gathers the population onto one
+    device fails here, not in a dashboard."""
+    errors: List[str] = []
+    if not isinstance(mh, dict):
+        return [f"{where}: roofline.multihost is not an object"]
+    for key, floor in (
+        ("process_count", 2),
+        ("n_local_devices", 1),
+        ("per_device_peak_bytes", 1),
+        ("per_process_peak_bytes", 1),
+        ("full_pop_bytes", 1),
+        ("collective_bytes_estimate", 0),
+    ):
+        v = mh.get(key)
+        if not isinstance(v, int) or v < floor:
+            errors.append(
+                f"{where}: roofline.multihost.{key} missing or below "
+                f"{floor}"
+            )
+    per_dev = mh.get("per_device_peak_bytes")
+    per_proc = mh.get("per_process_peak_bytes")
+    n_local = mh.get("n_local_devices")
+    full = mh.get("full_pop_bytes")
+    if (
+        isinstance(per_dev, int)
+        and isinstance(per_proc, int)
+        and isinstance(n_local, int)
+        and per_proc != per_dev * n_local
+    ):
+        errors.append(
+            f"{where}: roofline.multihost per_process_peak_bytes "
+            f"{per_proc} != per_device_peak_bytes {per_dev} * "
+            f"n_local_devices {n_local}"
+        )
+    if (
+        isinstance(per_dev, int)
+        and isinstance(full, int)
+        and full > 0
+        and per_dev >= full
+    ):
+        errors.append(
+            f"{where}: roofline.multihost per_device_peak_bytes "
+            f"{per_dev} >= full_pop_bytes {full} — the pod program "
+            "materializes the full population per device"
         )
     return errors
 
@@ -828,6 +889,10 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             # v7: the serving_elastic leg's vs_baseline is the measured
             # warm-vs-recompile cold-start speedup — the PR-12 claim
             ("elastic serving", "its cold-start (warm vs recompile) ratio"),
+            # v8: the multihost leg's vs_baseline is the measured
+            # 2-process-vs-1-process ratio (the ISSUE-13 claim); a leg
+            # present without it is an asserted win
+            ("multihost", "its 1-process solo-baseline ratio"),
         ):
             if keyword not in metric_l:
                 continue
@@ -896,6 +961,52 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
                         f"peak {sh} >= replicated peak {rp} — sharding "
                         "bought no memory"
                     )
+    mh = summary.get("multihost")
+    if isinstance(mh, dict) and "error" not in mh:
+        table = mh.get("static_bytes")
+        if not isinstance(table, dict):
+            errors.append(
+                f"{where}: multihost.static_bytes missing — the AOT "
+                "per-process table is the leg's referee"
+            )
+        else:
+            solo = table.get("solo_per_process_peak_bytes")
+            if not isinstance(solo, int) or solo < 1:
+                errors.append(
+                    f"{where}: multihost.static_bytes."
+                    "solo_per_process_peak_bytes missing or not a "
+                    "positive int"
+                )
+            pod = table.get("pod_per_process_peak_bytes")
+            if pod is not None:
+                if not isinstance(pod, int) or pod < 1:
+                    errors.append(
+                        f"{where}: multihost.static_bytes."
+                        "pod_per_process_peak_bytes neither null nor a "
+                        "positive int"
+                    )
+                elif isinstance(solo, int) and pod >= solo:
+                    errors.append(
+                        f"{where}: multihost.static_bytes pod per-process "
+                        f"peak {pod} >= solo peak {solo} — scaling out "
+                        "bought no per-process memory"
+                    )
+            elif not isinstance(table.get("note"), str) and not isinstance(
+                mh.get("skip_reason"), str
+            ):
+                # the measured pod-side number is legitimately absent
+                # only where the backend cannot compile a multiprocess
+                # program — the capture must SAY so (the large_pop
+                # note discipline)
+                errors.append(
+                    f"{where}: multihost.static_bytes has no pod "
+                    "per-process peak and no explanatory note/"
+                    "skip_reason — the scale-out claim is unmeasured"
+                )
+        if mh.get("run_report") is not None:
+            errors += validate_run_report(
+                mh["run_report"], where=f"{where}: multihost.run_report"
+            )
     sv = summary.get("serving")
     if isinstance(sv, dict) and "error" not in sv:
         cs = sv.get("cold_start")
