@@ -17,11 +17,18 @@ from .distributed import (
     all_gather,
     tree_all_gather,
     init_distributed,
+    shutdown_distributed,
     process_id,
     process_count,
     is_dist_initialized,
+    BarrierTimeoutError,
 )
 from .executor import GenerationExecutor
+from .pod_supervisor import (
+    CollectiveDeadlineError,
+    PodFailureError,
+    PodSupervisor,
+)
 from .exec_cache import (
     ExecCacheError,
     ExecCacheMissError,
@@ -93,8 +100,13 @@ __all__ = [
     "all_gather",
     "tree_all_gather",
     "init_distributed",
+    "shutdown_distributed",
     "process_id",
     "process_count",
     "is_dist_initialized",
+    "BarrierTimeoutError",
+    "CollectiveDeadlineError",
+    "PodFailureError",
+    "PodSupervisor",
     "state_io",
 ]

@@ -60,9 +60,11 @@ __all__ = [
     "ShardedES",
     "sharded_es_tell",
     "init_distributed",
+    "shutdown_distributed",
     "process_id",
     "process_count",
     "is_dist_initialized",
+    "BarrierTimeoutError",
     "pod_devices",
     "create_pod_mesh",
     "mesh_spans_processes",
@@ -500,7 +502,15 @@ class ShardedES:
         n_shards: sampling-law shard count; defaults to the mesh's
             ``axis_name`` size (or 1 without a mesh). Pass explicitly on
             ``mesh=None`` to build the replicated reference of an n-device
-            sharded run.
+            sharded run. May be any positive MULTIPLE of the mesh's
+            ``axis_name`` size: each device then draws
+            ``n_shards / n_dev`` consecutive sample blocks from its
+            global block indices — the SAME sampling law on fewer
+            devices, which is what makes a pod run topology-portable
+            (an 8-shard trajectory killed mid-flight resumes on a
+            4-device survivor mesh with ``n_shards=8`` and reproduces
+            the uninjured law up to psum order; the pod-supervisor
+            shrink-and-resume path, ISSUE 14).
     """
 
     is_pop_sharded = False  # overridden per instance when a mesh is given
@@ -526,10 +536,13 @@ class ShardedES:
         if n_shards is None:
             n_shards = int(mesh.shape[axis_name]) if mesh is not None else 1
         self.n_shards = int(n_shards)
-        if mesh is not None and int(mesh.shape[axis_name]) != self.n_shards:
+        if self.n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {self.n_shards}")
+        if mesh is not None and self.n_shards % int(mesh.shape[axis_name]):
             raise ValueError(
-                f"n_shards={self.n_shards} disagrees with the mesh's "
-                f"'{axis_name}' axis ({int(mesh.shape[axis_name])})"
+                f"n_shards={self.n_shards} is not a multiple of the mesh's "
+                f"'{axis_name}' axis ({int(mesh.shape[axis_name])}); the "
+                "per-shard sampling law needs whole blocks per device"
             )
         pop = int(algorithm.pop_size)
         if pop % self.n_shards != 0:
@@ -631,11 +644,32 @@ class ShardedES:
             from ..utils.compat import shard_map  # deferred (cycle-safe)
 
             axis = self.axis_name
+            # n_shards may exceed the device count (shrunken survivor
+            # mesh resuming a wider run's sampling law): device d owns
+            # the consecutive global blocks [d*bpd, (d+1)*bpd) and
+            # concatenates them — identical draws to the wider mesh,
+            # just fewer devices holding more blocks each
+            bpd = self.n_shards // int(self.mesh.shape[axis])
 
             def island(st, k_op):
-                s = jax.lax.axis_index(axis)
-                return self.algorithm.ask_rows(
-                    st, jax.random.fold_in(k_op, s), shard
+                d = jax.lax.axis_index(axis)
+                if bpd == 1:
+                    return self.algorithm.ask_rows(
+                        st, jax.random.fold_in(k_op, d), shard
+                    )
+                pops_b, arts_b = [], []
+                for b in range(bpd):
+                    p_b, a_b = self.algorithm.ask_rows(
+                        st, jax.random.fold_in(k_op, d * bpd + b), shard
+                    )
+                    pops_b.append(p_b)
+                    arts_b.append(a_b)
+                return (
+                    jnp.concatenate(pops_b),
+                    {
+                        name: jnp.concatenate([a[name] for a in arts_b])
+                        for name in fields
+                    },
                 )
 
             pop, art = shard_map(
@@ -720,6 +754,26 @@ def _dist_client():
         return _jd.global_state.client
     except Exception:  # pragma: no cover - exotic jax builds
         return _INTROSPECT_FAILED
+
+
+def _dist_process_info() -> Tuple[int, int]:
+    """(process_id, num_processes) of the ACTIVE jax.distributed runtime
+    WITHOUT touching the backend: ``jax.process_count()`` initializes
+    the backend, and a multiprocess CPU backend init BLOCKS until every
+    peer initializes too — so a barrier called before the backend is up
+    (the pod supervisor's join/warmup rendezvous, a coordination-only
+    worker) would wedge exactly where it must not. Falls back to the
+    backend-derived counts only when the runtime exposes nothing."""
+    try:
+        from jax._src import distributed as _jd
+
+        gs = _jd.global_state
+        pid, n = gs.process_id, gs.num_processes
+        if pid is not None and n is not None:
+            return int(pid), int(n)
+    except Exception:  # pragma: no cover - exotic jax builds
+        pass
+    return int(jax.process_index()), int(jax.process_count())
 
 
 def _current_dist_config() -> dict:
@@ -811,6 +865,11 @@ def init_distributed(
             stacklevel=2,
         )
         return
+    # cache hardening (ISSUE 14 satellite): any jitted-replicate closure
+    # cached for a PREVIOUS topology (a pod this process left via
+    # shutdown_distributed, or a pre-distributed backend) must never run
+    # on the re-formed pod — it was compiled for the dead device set
+    _replicate_program.cache_clear()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -818,6 +877,39 @@ def init_distributed(
         **kwargs,
     )
     _INIT_RECORD = {k: v for k, v in requested.items() if v is not None}
+
+
+def shutdown_distributed() -> None:
+    """Tear down this process's ``jax.distributed`` membership (no-op
+    when none is active) and invalidate every cross-process-compiled
+    host-readback program.
+
+    The ``host_value``/``tree_host_value`` replicate closures are cached
+    per ``NamedSharding`` (:func:`_replicate_program`); a pod that
+    re-forms after a failure builds a NEW mesh, but a sharding that
+    hashes equal to a dead pod's (same spec, revived device objects on
+    exotic backends) would silently reuse a program compiled for the
+    dead topology and wedge the first readback of the healed run. The
+    cache is therefore dropped on BOTH edges — here at shutdown and in
+    :func:`init_distributed`'s real-init path — so a re-formed pod
+    always compiles its gathers against the live topology
+    (regression-tested via the re-init guard path, tests/
+    test_pod_supervisor.py::
+    test_replicate_cache_invalidated_on_shutdown_and_reinit)."""
+    global _INIT_RECORD
+    _replicate_program.cache_clear()
+    _INIT_RECORD = None
+    client = _dist_client()
+    if client is not _INTROSPECT_FAILED and client is None:
+        return
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # pragma: no cover - backend-dependent teardown
+        warnings.warn(
+            f"shutdown_distributed: jax.distributed.shutdown raised "
+            f"{type(e).__name__}: {e} (caches were still invalidated)",
+            stacklevel=2,
+        )
 
 
 def process_id() -> int:
@@ -925,6 +1017,41 @@ def mesh_spans_processes(mesh: Optional[Mesh]) -> bool:
 
 _BARRIER_SEQ = [0]
 
+#: KV prefix under which every process records its barrier arrival — the
+#: census the timeout path reads to NAME the processes that never came
+_BARRIER_KV_PREFIX = "evox_tpu/barrier_arrival"
+
+
+class BarrierTimeoutError(RuntimeError):
+    """A :func:`process_barrier` deadline expired with peers missing —
+    the cross-process twin of the dispatch-deadline error (ISSUE 14
+    satellite: a barrier with a dead peer must raise a CLASSIFIED
+    deadline naming the processes that never arrived, not block forever
+    or die with an opaque coordination-service string).
+    ``classify_error`` folds it into the ``deadline`` class; the pod
+    supervisor refines it into worker-dead / hung-collective via the
+    heartbeat census. ``arrived``/``missing`` are sorted process-id
+    lists reconstructed from the barrier's KV arrival records."""
+
+    def __init__(
+        self,
+        name: str,
+        timeout_s: float,
+        arrived: Sequence[int],
+        missing: Sequence[int],
+        cause: str = "",
+    ):
+        self.barrier_name = name
+        self.timeout_s = timeout_s
+        self.arrived = sorted(int(p) for p in arrived)
+        self.missing = sorted(int(p) for p in missing)
+        detail = f" [{cause}]" if cause else ""
+        super().__init__(
+            f"process_barrier '{name}' timed out after {timeout_s:g} s: "
+            f"processes {self.missing or '<unknown>'} never arrived "
+            f"(arrived: {self.arrived}){detail}"
+        )
+
 
 def process_barrier(name: Optional[str] = None, timeout_s: float = 120.0) -> None:
     """Block until every process reached this barrier.
@@ -936,14 +1063,72 @@ def process_barrier(name: Optional[str] = None, timeout_s: float = 120.0) -> Non
     same barriers in the same order (auto-generated names are a per-
     process counter). The checkpoint commit protocol is the canonical
     user: non-zero processes must not proceed past a save point before
-    process 0's manifest is durable."""
+    process 0's manifest is durable.
+
+    Deadline discipline (ISSUE 14): each process records its arrival in
+    the coordinator KV store before waiting, so when the wait times out
+    — a peer was SIGKILLed, wedged, or preempted — the survivor raises
+    :class:`BarrierTimeoutError` NAMING the processes that never
+    arrived instead of surfacing the coordination service's opaque
+    deadline string (regression-tested with a real non-arriving child,
+    tests/test_pod_supervisor.py::
+    test_process_barrier_timeout_names_missing_process). Process 0
+    deletes the arrival records after a successful pass so long runs
+    don't accrete KV garbage."""
     client = _dist_client()
-    if client is None or jax.process_count() <= 1:
+    if client is None:
         return
+    # process identity from the distributed runtime, NOT the backend:
+    # jax.process_count() would initialize the backend, and multiprocess
+    # CPU backend init blocks on every peer — a barrier must stay a
+    # pure coordination-service operation (it is what startup code and
+    # the pod supervisor rendezvous on)
+    pid, nprocs = _dist_process_info()
+    if nprocs <= 1:
+        return
+    if client is _INTROSPECT_FAILED:  # pragma: no cover - exotic builds
+        # multi-process with no readable client: a silent no-op here
+        # would turn the checkpoint COMMIT barrier into a data race
+        # (a non-writer could resume a manifest that is not yet
+        # durable) — fail loudly instead
+        raise RuntimeError(
+            "process_barrier: this jax build exposes no distributed-"
+            "runtime client introspection, so a multi-process rendezvous "
+            "cannot be performed safely"
+        )
     if name is None:
         _BARRIER_SEQ[0] += 1
         name = f"evox_tpu_barrier_{_BARRIER_SEQ[0]}"
-    client.wait_at_barrier(name, int(timeout_s * 1000))
+    kv_dir = f"{_BARRIER_KV_PREFIX}/{name}"
+    try:
+        client.key_value_set(f"{kv_dir}/{pid}", "1")
+    except Exception:  # arrival bookkeeping must never fail the barrier
+        pass
+    try:
+        client.wait_at_barrier(name, int(timeout_s * 1000))
+    except Exception as e:
+        msg = str(e)
+        low = msg.lower()
+        if "barrier timed out" in low or "deadline_exceeded" in low:
+            arrived: list = []
+            try:
+                arrived = [
+                    int(k.rsplit("/", 1)[-1])
+                    for k, _ in client.key_value_dir_get(kv_dir + "/")
+                ]
+            except Exception:
+                pass  # census unavailable (coordinator dying): keep []
+            missing = sorted(set(range(nprocs)) - set(arrived))
+            raise BarrierTimeoutError(
+                name, timeout_s, arrived, missing, cause=msg.splitlines()[0]
+            ) from e
+        raise
+    if pid == 0:
+        try:
+            for k, _ in client.key_value_dir_get(kv_dir + "/"):
+                client.key_value_delete(k)
+        except Exception:
+            pass
 
 
 def _is_typed_key(x: Any) -> bool:

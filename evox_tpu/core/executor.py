@@ -239,6 +239,7 @@ class GenerationExecutor:
         max_staleness: int = 0,
         io_inflight: int = 4,
         supervisor: Any = None,
+        pod_supervisor: Any = None,
         fetch_monitors_every: Optional[int] = None,
         clock: Callable[[], float] = time.perf_counter,
     ):
@@ -251,6 +252,12 @@ class GenerationExecutor:
         self.max_staleness = int(max_staleness)
         self.io_inflight = int(io_inflight)
         self.supervisor = supervisor
+        # pod fault domain (core/pod_supervisor.py, ISSUE 14): when
+        # attached, run_fused puts every SPMD-lockstep collective point
+        # (chunk dispatch, pod checkpoint gather) under the pod deadline
+        # + classification, rendezvouses at chunk boundaries, and honors
+        # the coordinated SIGTERM drain. None (default) changes nothing.
+        self.pod_supervisor = pod_supervisor
         self.fetch_monitors_every = fetch_monitors_every
         self._clock = clock
         self._created = clock()
@@ -373,6 +380,7 @@ class GenerationExecutor:
         chunk: Optional[int] = None,
         resume_from: Any = None,
         supervisor: Any = None,
+        pod_supervisor: Any = None,
         entry: str = "run",
     ) -> Any:
         """Drive ``wf.run(state, n)``-shaped fused dispatches in cadence
@@ -386,13 +394,32 @@ class GenerationExecutor:
         restore rung replaying from the newest drained snapshot.
         ``n_steps`` counts REMAINING generations (``resume_from``
         reinterprets it as the TOTAL target, exactly ``wf.run``'s law).
+
+        ``pod_supervisor`` (a :class:`~evox_tpu.core.pod_supervisor.
+        PodSupervisor`, ISSUE 14): every SPMD-lockstep collective point
+        — the chunk dispatch and, on pod meshes, the synchronous
+        checkpoint gather — runs under the pod's disposable-watchdog
+        deadline with census-refined failure classification, each chunk
+        ends in the classified :meth:`chunk_boundary` rendezvous, and a
+        coordinated drain (SIGTERM preemption) finishes the in-flight
+        chunk, fsyncs a FINAL barrier checkpoint even off-cadence,
+        drains the background lane, and returns early — the caller then
+        exits 0. Pod faults surface as :class:`~evox_tpu.core.
+        pod_supervisor.PodFailureError` (fatal to the in-process ladder
+        by design; re-formation happens in the respawn driver). ``None``
+        (default) leaves this loop bit-identical to the pre-pod tree.
         """
         from ..workflows.checkpoint import chunk_to_boundary, enter_run
 
         supervisor = self.supervisor if supervisor is None else supervisor
+        pod = (
+            self.pod_supervisor if pod_supervisor is None else pod_supervisor
+        )
         wf._run_executor = self
         if supervisor is not None:
             wf._run_supervisor = supervisor
+        if pod is not None:
+            wf._pod_supervisor = pod
         state, n_steps, ckpt = enter_run(
             state, n_steps, checkpointer, resume_from, expect_like=state
         )
@@ -415,8 +442,16 @@ class GenerationExecutor:
                 remaining = total - int(state.generation)
                 step = min(remaining, chunk_to_boundary(state, ckpt, chunk))
                 attempted = state
+                chunk_fn = lambda: wf.run(attempted, step)  # noqa: E731
+                if pod is not None:
+                    # innermost: the pod watchdog wraps the raw lockstep
+                    # dispatch so a hung collective is classified before
+                    # any in-process ladder sees it
+                    chunk_fn = lambda: pod.supervised(  # noqa: E731
+                        lambda: wf.run(attempted, step), entry=entry
+                    )
                 dispatch = lambda: self._timed_dispatch(  # noqa: E731
-                    entry, lambda: wf.run(attempted, step)
+                    entry, chunk_fn
                 )
                 if supervisor is not None:
                     self.counters["supervised_chunks"] += 1
@@ -435,14 +470,32 @@ class GenerationExecutor:
                     self.counters["generations"] += gen - int(
                         attempted.generation
                     )
+                # pod rendezvous BEFORE the snapshot decision: the drain
+                # law's final checkpoint must be the newest barrier, so
+                # a drain decided here forces an (off-cadence) save below
+                drain = (
+                    progressed
+                    and pod is not None
+                    and pod.chunk_boundary(gen) == "drain"
+                )
                 if (
                     ckpt is not None
                     and progressed
-                    and (gen % ckpt.every == 0 or gen >= total)
+                    and (gen % ckpt.every == 0 or gen >= total or drain)
                 ):
                     # only snapshot forward progress — the restore rung
                     # hands back an OLDER state that is already durable
-                    self._submit_checkpoint(lane, ckpt, state)
+                    self._submit_checkpoint(lane, ckpt, state, pod=pod)
+                if drain:
+                    # preemption-graceful stop: in-flight chunk finished,
+                    # final barrier checkpoint submitted — make it (and
+                    # every earlier snapshot) durable, record, hand back.
+                    # checkpointer-less runs drain too (the process must
+                    # still stop cleanly) but the record says no final
+                    # snapshot exists — nothing claims resumability
+                    lane.drain()
+                    pod.note_drained(gen, checkpointed=ckpt is not None)
+                    return state
             lane.drain()  # every snapshot durable before the run returns
             return state
         except BaseException:
@@ -700,7 +753,9 @@ class GenerationExecutor:
                 # atomic), so the drain error is dropped HERE only
                 pass
 
-    def _submit_checkpoint(self, lane: _IoLane, ckpt: Any, state: Any) -> None:
+    def _submit_checkpoint(
+        self, lane: _IoLane, ckpt: Any, state: Any, pod: Any = None
+    ) -> None:
         self.counters["bg_checkpoint"] += 1
         t0 = self._clock()
         if jax.process_count() > 1:
@@ -708,8 +763,21 @@ class GenerationExecutor:
             # and barriers across processes — both must run in SPMD
             # lockstep on the admitting thread, never interleaved from a
             # background lane (each process's lanes drain independently,
-            # which would reorder the collectives and deadlock the pod)
-            ckpt.save(state)
+            # which would reorder the collectives and deadlock the pod).
+            # Under a pod supervisor the gather+barrier is itself a
+            # supervised collective point: a peer dying mid-save raises
+            # a classified PodFailureError instead of wedging the pod.
+            # The save gets its OWN (larger) deadline — a full host
+            # gather legitimately outlasts a chunk dispatch, and the
+            # chunk bound would abort a healthy pod at every cadence
+            if pod is not None:
+                pod.supervised(
+                    lambda: ckpt.save(state),
+                    entry="checkpoint",
+                    deadline_s=getattr(pod, "checkpoint_deadline_s", None),
+                )
+            else:
+                ckpt.save(state)
             self._span("io:checkpoint", "save", t0, self._clock() - t0,
                        generation=int(state.generation))
             return

@@ -620,6 +620,7 @@ def run_report(
     analyzer: Optional[CostAnalyzer] = None,
     supervisor: Any = None,
     executor: Any = None,
+    pod_supervisor: Any = None,
 ) -> dict:
     """Merge device telemetry and host dispatch timings into ONE
     JSON-serializable dict.
@@ -666,7 +667,13 @@ def run_report(
     # optional roofline `multihost` subsection (ISSUE 13: multi-process
     # runs cite their per-process AOT peak and a collective-bytes
     # estimate next to the sharding evidence) — validated when present.
-    report: dict = {"schema": "evox_tpu.run_report/v8"}
+    # v9 adds the optional `pod_supervisor` section (ISSUE 14,
+    # core/pod_supervisor.py: heartbeat censuses, collective-deadline
+    # failures with worker_dead/hung_collective/coordinator_loss
+    # classification, coordinated drains, re-formation/resume events) —
+    # validated when present, incl. the monotonic-census and
+    # reform↔resume coherence rules.
+    report: dict = {"schema": "evox_tpu.run_report/v9"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -782,6 +789,14 @@ def run_report(
         supervisor = getattr(workflow, "_run_supervisor", None)
     if supervisor is not None and hasattr(supervisor, "report"):
         report["supervisor"] = supervisor.report()
+    # pod supervisor (core/pod_supervisor.py, schema v9): a pod-
+    # supervised run advertises itself as `_pod_supervisor` — heartbeat
+    # censuses, classified failures, drains, and reform/resume events
+    # become the `pod_supervisor` section (duck-typed like the others)
+    if pod_supervisor is None and workflow is not None:
+        pod_supervisor = getattr(workflow, "_pod_supervisor", None)
+    if pod_supervisor is not None and hasattr(pod_supervisor, "report"):
+        report["pod_supervisor"] = pod_supervisor.report()
     # generation executor (core/executor.py): the workflow's most recent
     # executor-backed run advertises itself as `_run_executor` — queue
     # depth, overlap spans, and staleness counters become the `executor`
@@ -837,6 +852,7 @@ def write_chrome_trace(
     extra_counters: Optional[Dict[str, Sequence[Tuple[float, Any]]]] = None,
     supervisor: Any = None,
     executor: Any = None,
+    pod_supervisor: Any = None,
 ) -> dict:
     """Export a run as Chrome trace-event JSON (open in Perfetto or
     chrome://tracing) and return the trace dict.
@@ -980,6 +996,30 @@ def write_chrome_trace(
                         "name": m["name"],
                         "cat": "supervisor",
                         "pid": 3,
+                        "tid": 1,
+                        "ts": round(max(m["t_abs"] - t0, 0.0) * _US, 3),
+                        "s": "p",
+                        "args": sanitize_json(m.get("args", {})),
+                    }
+                )
+
+    # pod supervisor events (ISSUE 14, duck-typed from
+    # ``workflow._pod_supervisor``): ``supervisor:pod:*`` instant markers
+    # — join / census / barrier_timeout / failure / drain / reform /
+    # resume — on their own "pod supervisor" process, same clock
+    if pod_supervisor is None and workflow is not None:
+        pod_supervisor = getattr(workflow, "_pod_supervisor", None)
+    if pod_supervisor is not None and hasattr(pod_supervisor, "markers"):
+        markers = pod_supervisor.markers()
+        if markers:
+            events.append(meta(5, "pod supervisor"))
+            for m in markers:
+                events.append(
+                    {
+                        "ph": "i",
+                        "name": m["name"],
+                        "cat": "supervisor",
+                        "pid": 5,
                         "tid": 1,
                         "ts": round(max(m["t_abs"] - t0, 0.0) * _US, 3),
                         "s": "p",

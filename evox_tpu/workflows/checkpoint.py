@@ -205,11 +205,24 @@ class WorkflowCheckpointer:
             ``state.generation`` crosses a multiple of ``every``.
         keep: newest snapshots retained (older ones pruned after each
             successful save).
+        barrier_timeout_s: deadline for the pod save's commit barriers
+            (multi-process only). A peer SIGKILLed mid-save then raises
+            the classified
+            :class:`~evox_tpu.core.distributed.BarrierTimeoutError`
+            naming the missing processes after this bound instead of
+            holding the survivors for the 120 s default (ISSUE 14; the
+            pod supervisor further refines it through the census).
     """
 
     _CONFIG = "checkpointer.json"
 
-    def __init__(self, directory: str, every: int = 10, keep: int = 3):
+    def __init__(
+        self,
+        directory: str,
+        every: int = 10,
+        keep: int = 3,
+        barrier_timeout_s: Optional[float] = None,
+    ):
         if every < 1:
             raise ValueError(f"every must be >= 1, got {every}")
         if keep < 1:
@@ -218,6 +231,15 @@ class WorkflowCheckpointer:
         self.directory.mkdir(parents=True, exist_ok=True)
         self.every = every
         self.keep = keep
+        self.barrier_timeout_s = barrier_timeout_s
+
+    def _commit_barrier(self) -> None:
+        from ..core.distributed import process_barrier
+
+        if self.barrier_timeout_s is None:
+            process_barrier()
+        else:
+            process_barrier(timeout_s=self.barrier_timeout_s)
 
     def _write_config(self) -> None:
         """Persist (every, keep) next to the snapshots, so a resume that
@@ -251,7 +273,7 @@ class WorkflowCheckpointer:
         multiproc = jax.process_count() > 1
         shardings = _leaf_shardings(state)
         if multiproc:
-            from ..core.distributed import process_barrier, tree_host_value
+            from ..core.distributed import tree_host_value
 
             # collective all-gather: every process ends with the FULL
             # host value of every leaf (identical bytes on each process)
@@ -263,7 +285,7 @@ class WorkflowCheckpointer:
         if multiproc and jax.process_index() != 0:
             # process-0-writes: wait for the writer's manifest commit
             # (save() below hits the same barrier after its writes)
-            process_barrier()
+            self._commit_barrier()
             return path
         payload = pickle.dumps(host_state, protocol=pickle.HIGHEST_PROTOCOL)
         _write_durable(path, payload, ".pkl.tmp")
@@ -297,11 +319,9 @@ class WorkflowCheckpointer:
         self._write_config()
         self._prune()
         if multiproc:
-            from ..core.distributed import process_barrier
-
             # release the non-writer processes only after the manifest
             # (the commit record) is durable on disk
-            process_barrier()
+            self._commit_barrier()
         return path
 
     def maybe_save(self, state: Any) -> Optional[Path]:

@@ -72,6 +72,17 @@ EVENT_KINDS = (
     # TARGET bucket's journal)
     "preempt",
     "autoscale",
+    # pod membership transitions (ISSUE 14, core/pod_supervisor.py —
+    # process-0-writes, the checkpoint commit discipline): a member
+    # joining a pod epoch, a classified pod fault (worker_dead /
+    # hung_collective / coordinator_loss + detection latency), a
+    # coordinated SIGTERM drain close-out, a re-formation onto the
+    # survivor set, and the barrier-snapshot resume that completes it
+    "pod_join",
+    "pod_failure",
+    "pod_drain",
+    "pod_reform",
+    "pod_resume",
 )
 
 

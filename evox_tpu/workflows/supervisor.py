@@ -56,10 +56,15 @@ from __future__ import annotations
 
 import random
 import re
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core.distributed import BarrierTimeoutError
+from ..core.pod_supervisor import (
+    CollectiveDeadlineError,
+    PodFailureError,
+    _watchdog_call,
+)
 from .checkpoint import WorkflowCheckpointer
 
 __all__ = [
@@ -140,9 +145,15 @@ def classify_error(exc: BaseException) -> str:
     fatal — a supervisor never re-litigates another's verdict), and
     patterns are matched against the MESSAGE only, never the type name
     (``RunAbortedError``'s own name must not read as 'aborted')."""
-    if isinstance(exc, DispatchDeadlineError):
+    if isinstance(exc, (DispatchDeadlineError, CollectiveDeadlineError, BarrierTimeoutError)):
+        # the pod-level deadlines (ISSUE 14) fold into the same class as
+        # the dispatch watchdog's: a bounded wait expired
         return DEADLINE
-    if isinstance(exc, RunAbortedError):
+    if isinstance(exc, (RunAbortedError, PodFailureError)):
+        # a classified pod fault (worker dead / hung collective /
+        # coordinator loss) cannot be healed by retrying IN this process
+        # — the escalation continues in the re-formation driver, so the
+        # in-process ladder must abort, not spin
         return FATAL
     if isinstance(exc, MemoryError):
         return OOM
@@ -167,30 +178,20 @@ def _call_with_deadline(
     ``deadline_s`` (None = no watchdog, call inline). A fresh thread per
     call is deliberate: a hung call occupies its thread forever, so
     pooling would poison the pool. ~50 µs of thread spawn is noise next
-    to the 45-100 ms tunnel round-trip every dispatch already pays."""
-    if deadline_s is None:
-        return fn()
-    box: dict = {}
-    done = threading.Event()
-
-    def target():
-        try:
-            box["value"] = fn()
-        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
-            box["error"] = e
-        finally:
-            done.set()
-
-    t = threading.Thread(target=target, daemon=True, name=f"supervised:{label}")
-    t.start()
-    if not done.wait(deadline_s):
-        raise DispatchDeadlineError(
-            f"dispatch '{label}' exceeded its {deadline_s:g} s deadline; "
+    to the 45-100 ms tunnel round-trip every dispatch already pays.
+    One shared body with the pod fault domain's collective watchdog
+    (core/pod_supervisor.py — this call supplies the dispatch-flavored
+    timeout exception)."""
+    return _watchdog_call(
+        fn,
+        deadline_s,
+        label,
+        make_timeout=lambda lbl, dl: DispatchDeadlineError(
+            f"dispatch '{lbl}' exceeded its {dl:g} s deadline; "
             "the worker thread is abandoned (a wedged tunnel never answers)"
-        )
-    if "error" in box:
-        raise box["error"]
-    return box["value"]
+        ),
+        thread_prefix="supervised",
+    )
 
 
 # event kind -> cumulative counter it increments
@@ -422,6 +423,7 @@ class RunSupervisor:
         chunk: Optional[int] = None,
         resume_from: Any = None,
         executor: Any = None,
+        pod_supervisor: Any = None,
     ) -> Any:
         """Supervised ``wf.run``: the fused device loop is chunked (at the
         checkpointer cadence, else ``chunk`` generations, else one
@@ -445,7 +447,11 @@ class RunSupervisor:
         snapshots land on the executor's background checkpoint lane,
         drained before any restore replays and before the run returns.
         Pass ``executor=`` to accumulate counters/spans on a shared
-        instance."""
+        instance, and ``pod_supervisor=`` (a
+        :class:`~evox_tpu.core.pod_supervisor.PodSupervisor`) to put
+        every SPMD-lockstep collective point under the pod fault domain
+        — collective deadlines, chunk-boundary rendezvous, coordinated
+        SIGTERM drain (ISSUE 14)."""
         from ..core.executor import GenerationExecutor
 
         ex = executor if executor is not None else GenerationExecutor()
@@ -457,6 +463,7 @@ class RunSupervisor:
             chunk=chunk,
             resume_from=resume_from,
             supervisor=self,
+            pod_supervisor=pod_supervisor,
         )
 
     # --------------------------------------------------------- pipelined runs

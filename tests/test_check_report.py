@@ -387,3 +387,143 @@ def test_validator_multihost_bench_rules():
         "CPU backend cannot run multiprocess collectives on jaxlib 0.4.36"
     )
     assert check_report.validate_bench(summary) == []
+
+
+def _pod_section():
+    """A coherent failed-pod section (the worker-dead shape)."""
+    return {
+        "process_id": 0,
+        "process_count": 2,
+        "epoch": 0,
+        "deadline_s": 5.0,
+        "heartbeat_interval_s": 0.2,
+        "outcome": "failed",
+        "counters": {
+            "heartbeats": 40,
+            "censuses": 1,
+            "barriers": 3,
+            "barrier_timeouts": 1,
+            "supervised_calls": 2,
+            "failures": 1,
+            "drains": 0,
+            "reforms": 0,
+            "resumes": 0,
+        },
+        "events": [
+            {"t": 0.0, "event": "join", "process_id": 0,
+             "process_count": 2, "epoch": 0},
+            {"t": 5.1, "event": "barrier_timeout",
+             "name": "evox_tpu/pod/e0/gen4", "missing": [1], "arrived": [0]},
+            {"t": 5.8, "event": "census", "alive": [0], "dead": [1]},
+            {"t": 5.9, "event": "failure", "entry": "barrier:gen4",
+             "classification": "worker_dead", "detect_s": 5.9,
+             "error": "BarrierTimeoutError: ..."},
+        ],
+    }
+
+
+def test_validator_pod_supervisor_rules():
+    """v9 pod_supervisor (ISSUE 14): a coherent failed section passes;
+    unknown event kinds, unknown classifications, a GROWING census, and
+    reform-without-resume incoherence all fail."""
+    report = _fresh_report(False)
+    good = json.loads(json.dumps(report))
+    good["pod_supervisor"] = _pod_section()
+    assert check_report.validate_run_report(good) == []
+
+    bad = json.loads(json.dumps(good))
+    bad["pod_supervisor"]["events"][1]["event"] = "heartbeat_missed"
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "heartbeat_missed" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["pod_supervisor"]["events"][3]["classification"] = "gremlins"
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "gremlins" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["pod_supervisor"]["events"].append(
+        {"t": 6.0, "event": "census", "alive": [0, 1], "dead": []}
+    )
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "grew" in errors and "monotonic" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["pod_supervisor"]["outcome"] = "exploded"
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "exploded" in errors
+
+
+def test_validator_pod_reform_resume_coherence():
+    """A reform without its completing resume (or a 'resumed' outcome
+    without a resume event) is the half-healed pod the validator must
+    reject; the full reform→resume pair passes."""
+    report = _fresh_report(False)
+    good = json.loads(json.dumps(report))
+    pod = _pod_section()
+    pod["outcome"] = "resumed"
+    pod["counters"]["reforms"] = 1
+    pod["counters"]["resumes"] = 1
+    pod["events"] = [
+        {"t": 0.0, "event": "join", "process_id": 0,
+         "process_count": 1, "epoch": 1},
+        {"t": 0.1, "event": "reform", "survivors": [0], "from_epoch": 0},
+        {"t": 2.0, "event": "resume", "generation": 4},
+    ]
+    good["pod_supervisor"] = pod
+    assert check_report.validate_run_report(good) == []
+
+    bad = json.loads(json.dumps(good))
+    bad["pod_supervisor"]["events"] = bad["pod_supervisor"]["events"][:2]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "reform but no resume" in errors
+    assert "'resumed' without a resume event" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["pod_supervisor"]["events"][2]["generation"] = -3
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "generation missing/negative" in errors
+
+
+def test_validator_pod_trace_markers():
+    """Chrome-trace rule: supervisor:pod:* markers must be instants
+    with a KNOWN pod event kind after the prefix."""
+    trace = {
+        "traceEvents": [
+            {"ph": "i", "cat": "supervisor", "pid": 5, "tid": 1,
+             "ts": 1.0, "name": "supervisor:pod:failure", "s": "p"},
+        ]
+    }
+    assert check_report.validate_chrome_trace(trace) == []
+    trace["traceEvents"].append(
+        {"ph": "i", "cat": "supervisor", "pid": 5, "tid": 1,
+         "ts": 2.0, "name": "supervisor:pod:kaboom", "s": "p"}
+    )
+    errors = "\n".join(check_report.validate_chrome_trace(trace))
+    assert "kaboom" in errors
+
+    trace = {
+        "traceEvents": [
+            {"ph": "X", "cat": "supervisor", "pid": 5, "tid": 1,
+             "ts": 1.0, "dur": 2.0, "name": "supervisor:pod:failure"},
+        ]
+    }
+    errors = "\n".join(check_report.validate_chrome_trace(trace))
+    assert "instant marker" in errors
+
+
+def test_validator_journal_pod_kinds():
+    """The WAL validator accepts the pod membership kinds (v9) and
+    still rejects unknown ones."""
+    journal = {
+        "path": "j/journal.jsonl",
+        "records": 3,
+        "last_seq": 2,
+        "events": {"pod_join": 1, "pod_failure": 1, "pod_resume": 1},
+        "recovered": False,
+        "torn_tail_dropped": 0,
+    }
+    assert check_report._validate_journal(journal, "t") == []
+    journal["events"] = {"pod_join": 2, "pod_detonate": 1}
+    errors = "\n".join(check_report._validate_journal(journal, "t"))
+    assert "pod_detonate" in errors

@@ -57,7 +57,11 @@ def _walker_setup(n, ep=1, max_steps=12, seed=0):
 
 
 @pytest.mark.parametrize("early_stop", [True, False], ids=["while", "fori"])
-@pytest.mark.parametrize("n", [5, 150])
+# n=150 is the stress shape; the n=5 variants carry the exactness law in
+# tier-1 (ISSUE 14 gate-headroom: the PR-2 slow-marking discipline)
+@pytest.mark.parametrize(
+    "n", [5, pytest.param(150, marks=pytest.mark.slow)]
+)
 def test_fused_mlp_exact_vs_plane_loop(n, early_stop):
     """Tiling, padding, both loop forms and the weight layout reproduce
     the plane math exactly (n=5 exercises padding, 150 one full tile

@@ -304,7 +304,22 @@ def _fake_fitness(pop, n_objs):
 KNOWN_UNVMAPPABLE = set()
 
 
-@pytest.mark.parametrize("name", sorted(_constructible()))
+# the heaviest vmap-contract params (compile-bound MOEAs / ensemble DE)
+# run slow-marked: the mechanical contract keeps full tier-1 breadth via
+# every other registered algorithm, and the full suite still sweeps all
+# (ISSUE 14 gate-headroom, the PR-2 slow-marking discipline)
+_VMAP_CONTRACT_SLOW = {"CoDE", "IMMOEA", "KnEA"}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [
+        pytest.param(n, marks=pytest.mark.slow)
+        if n in _VMAP_CONTRACT_SLOW
+        else n
+        for n in sorted(_constructible())
+    ],
+)
 def test_algorithm_vmap_contract(name):
     """vmap-ability as a state contract (PR 8, workflows/tenancy.py):
     every registered algorithm must run init -> (init_ask/init_tell ->)

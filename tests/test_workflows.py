@@ -196,7 +196,12 @@ def test_shard_map_eval_island_mo():
     np.testing.assert_allclose(f_island, f_single, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sharded_selection_across_moea_families():
+    # slow-marked (ISSUE 14, the PR-2 gate-headroom discipline): the
+    # sharded-selection LAW stays tier-1 via test_mo_operators'
+    # sharded-vs-replicated sort/truncate tests; this is the breadth
+    # sweep across MOEA families
     """Every GA-skeleton MOEA family that consumes the sharded sort must
     match its own single-device run (not just NSGA-II): covers the mesh
     plumbing through distinct select() implementations."""

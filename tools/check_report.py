@@ -41,6 +41,30 @@ SUPERVISOR_COUNTERS = (
     "degradations",
     "aborts",
 )
+# v9 (ISSUE 14, core/pod_supervisor.py): the pod fault domain's section
+POD_OUTCOMES = {"clean", "drained", "failed", "resumed"}
+POD_EVENTS = {
+    "join",
+    "census",
+    "barrier_timeout",
+    "failure",
+    "drain_requested",
+    "drain",
+    "reform",
+    "resume",
+}
+POD_FAILURE_CLASSES = {"worker_dead", "hung_collective", "coordinator_loss"}
+POD_COUNTERS = (
+    "heartbeats",
+    "censuses",
+    "barriers",
+    "barrier_timeouts",
+    "supervised_calls",
+    "failures",
+    "drains",
+    "reforms",
+    "resumes",
+)
 
 
 def _walk(obj: Any, path: str = "$") -> Iterator[Tuple[str, Any]]:
@@ -162,6 +186,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                         f"{where}: supervisor has an abort event but "
                         f"outcome {sup.get('outcome')!r}"
                     )
+    pod = report.get("pod_supervisor")
+    if pod is not None:
+        errors += _validate_pod_supervisor(pod, where)
     tenancy = report.get("tenancy")
     if tenancy is not None:
         errors += _validate_tenancy(tenancy, where)
@@ -282,6 +309,115 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                             "pipeline_tell entries show zero alias bytes — "
                             "the aliasing never reached the compiled program"
                         )
+    return errors
+
+
+def _validate_pod_supervisor(pod: Any, where: str) -> List[str]:
+    """The ``pod_supervisor`` section (schema v9, ISSUE 14,
+    core/pod_supervisor.py): known event kinds on a monotonic clock,
+    censuses whose alive set never GROWS within one pod epoch (members
+    leave by dying; they rejoin only through a re-formation, which is a
+    new report), classified failures, and reform ↔ resume coherence —
+    a report that claims a re-formation must show the barrier resume
+    that completes it, and vice versa for the ``resumed`` outcome."""
+    errors: List[str] = []
+    if not isinstance(pod, dict):
+        return [f"{where}: pod_supervisor is not an object"]
+    if pod.get("outcome") not in POD_OUTCOMES:
+        errors.append(
+            f"{where}: pod_supervisor.outcome {pod.get('outcome')!r} not "
+            f"in {sorted(POD_OUTCOMES)}"
+        )
+    for key in ("process_id", "process_count", "epoch"):
+        v = pod.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: pod_supervisor.{key} missing or not a "
+                "non-negative int"
+            )
+    counters = pod.get("counters")
+    if not isinstance(counters, dict):
+        errors.append(f"{where}: pod_supervisor.counters missing")
+    else:
+        for key in POD_COUNTERS:
+            v = counters.get(key)
+            if not isinstance(v, int) or v < 0:
+                errors.append(
+                    f"{where}: pod_supervisor.counters.{key} missing or "
+                    "not a non-negative int"
+                )
+    events = pod.get("events")
+    kinds_seen = []
+    if not isinstance(events, list):
+        errors.append(f"{where}: pod_supervisor.events missing")
+        events = []
+    last_t = float("-inf")
+    last_alive = None
+    for i, ev in enumerate(events):
+        loc = f"{where}: pod_supervisor.events[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        kind = ev.get("event")
+        kinds_seen.append(kind)
+        if kind not in POD_EVENTS:
+            errors.append(
+                f"{loc}.event {kind!r} not in {sorted(POD_EVENTS)}"
+            )
+        t = ev.get("t")
+        if not _num(t) or t < 0:
+            errors.append(f"{loc}.t missing/negative")
+        elif t < last_t:
+            errors.append(f"{loc}.t not monotonic")
+        else:
+            last_t = t
+        if kind == "census":
+            alive = ev.get("alive")
+            if not isinstance(alive, list):
+                errors.append(f"{loc}.alive missing")
+            else:
+                if last_alive is not None and not set(alive) <= set(
+                    last_alive
+                ):
+                    errors.append(
+                        f"{loc}: census alive set {alive} grew vs the "
+                        f"previous census {last_alive} — membership is "
+                        "monotonic within a pod epoch"
+                    )
+                last_alive = alive
+        if kind == "failure" and ev.get(
+            "classification"
+        ) not in POD_FAILURE_CLASSES:
+            errors.append(
+                f"{loc}.classification {ev.get('classification')!r} not "
+                f"in {sorted(POD_FAILURE_CLASSES)}"
+            )
+        if kind == "resume" and not (
+            isinstance(ev.get("generation"), int) and ev["generation"] >= 0
+        ):
+            errors.append(f"{loc}.generation missing/negative")
+    # reform ↔ resume coherence
+    if "reform" in kinds_seen and "resume" not in kinds_seen:
+        errors.append(
+            f"{where}: pod_supervisor records a reform but no resume — a "
+            "re-formed pod that never restored a barrier snapshot did "
+            "not actually heal"
+        )
+    if pod.get("outcome") == "resumed" and "resume" not in kinds_seen:
+        errors.append(
+            f"{where}: pod_supervisor.outcome 'resumed' without a resume "
+            "event"
+        )
+    if pod.get("outcome") == "failed" and "failure" not in kinds_seen:
+        errors.append(
+            f"{where}: pod_supervisor.outcome 'failed' without a failure "
+            "event"
+        )
+    if pod.get("outcome") == "drained" and "drain" not in kinds_seen:
+        errors.append(
+            f"{where}: pod_supervisor.outcome 'drained' without a drain "
+            "event"
+        )
     return errors
 
 
@@ -504,6 +640,12 @@ JOURNAL_KINDS = {
     # v7 (PR 12): SLA preemption and elastic-autoscale close-outs
     "preempt",
     "autoscale",
+    # v9 (ISSUE 14): pod membership transitions (core/pod_supervisor.py)
+    "pod_join",
+    "pod_failure",
+    "pod_drain",
+    "pod_reform",
+    "pod_resume",
 }
 
 
@@ -1132,6 +1274,15 @@ def validate_chrome_trace(trace: Any, where: str = "trace") -> List[str]:
                     f"{loc}: supervisor marker name {name!r} must start "
                     "with 'supervisor:'"
                 )
+            elif str(name).startswith("supervisor:pod:"):
+                # pod chaos markers (schema v9): the kind after the
+                # prefix must be a known pod event
+                kind = str(name)[len("supervisor:pod:"):]
+                if kind not in POD_EVENTS:
+                    errors.append(
+                        f"{loc}: pod marker kind {kind!r} not in "
+                        f"{sorted(POD_EVENTS)}"
+                    )
         if ph == "C":
             key = (ev.get("pid"), ev.get("name"))
             if ev["ts"] < counters_last_ts.get(key, float("-inf")):
