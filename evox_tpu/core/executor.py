@@ -274,6 +274,9 @@ class GenerationExecutor:
             "bg_checkpoint": 0,
             "bg_hook": 0,
             "bg_fetch": 0,
+            # surrogate refits dispatched between tells (ISSUE 15,
+            # workflows/surrogate.py refit_due/dispatch_refit hooks)
+            "bg_refit": 0,
         }
         self.queue_stats: Dict[str, int] = {
             "io_inflight_limit": self.io_inflight,
@@ -836,11 +839,24 @@ class GenerationExecutor:
         asked = 0
         told = 0
         base = state
+        # surrogate hooks (ISSUE 15, workflows/surrogate.py — duck-typed
+        # so core stays decoupled): host_evaluate slices the screened
+        # batch to its truly evaluated rows before the expensive host
+        # problem sees it; refit_due/dispatch_refit refit the surrogate
+        # between tells as a SEPARATE async-dispatched program — the
+        # loop never blocks on it, and the model an ask consumes lags
+        # the archive by at most the workflow's refit cadence (the
+        # bounded-staleness discipline applied to the model)
+        host_eval = getattr(wf, "host_evaluate", None)
+        refit_due = getattr(wf, "refit_due", None)
+        dispatch_refit = getattr(wf, "dispatch_refit", None)
 
         def submit_eval(cand, pstate):
             def run_eval():
                 t0 = self._clock()
                 try:
+                    if host_eval is not None:
+                        return host_eval(pstate, cand, eval_chunk)
                     return chunked_evaluate(wf.problem, pstate, cand, eval_chunk)
                 finally:
                     dt = self._clock() - t0
@@ -923,6 +939,20 @@ class GenerationExecutor:
                 told += 1
                 self.counters["tells"] += 1
                 self.counters["generations"] += 1
+                if (
+                    refit_due is not None
+                    and dispatch_refit is not None
+                    and refit_due(gen0 + told)
+                ):
+                    # BEFORE the snapshot decision: a checkpoint at this
+                    # boundary must embed the refit, so a resumed run
+                    # reproduces the schedule (pure in the absolute
+                    # generation). Async dispatch — no host block.
+                    self.counters["bg_refit"] += 1
+                    base = self._timed_dispatch(
+                        "surrogate_refit",
+                        lambda: dispatch_refit(base, gen0 + told),
+                    )
                 if checkpointer is not None:
                     if int(base.generation) % checkpointer.every == 0:
                         self._submit_checkpoint(ckpt_lane, checkpointer, base)

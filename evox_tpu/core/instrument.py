@@ -672,8 +672,12 @@ def run_report(
     # failures with worker_dead/hung_collective/coordinator_loss
     # classification, coordinated drains, re-formation/resume events) —
     # validated when present, incl. the monotonic-census and
-    # reform↔resume coherence rules.
-    report: dict = {"schema": "evox_tpu.run_report/v9"}
+    # reform↔resume coherence rules. v10 adds the optional `surrogate`
+    # section (ISSUE 15, workflows/surrogate.py: archive fill, refit
+    # count/staleness, the screened-vs-true eval ledger, health
+    # readings, chronological fallback events) — validated when present,
+    # incl. the counter-sum and event-ordering coherence rules.
+    report: dict = {"schema": "evox_tpu.run_report/v10"}
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -711,6 +715,15 @@ def run_report(
         ipop_events = getattr(workflow, "_ipop_events", None)
         if ipop_events:
             report.setdefault("guardrail", {})["ipop"] = list(ipop_events)
+        # surrogate pre-screening (schema v10, workflows/surrogate.py):
+        # the archive/refit/eval-count ledger proving how many TRUE
+        # evaluations the run spent — duck-typed, core never imports the
+        # workflows package
+        if hasattr(workflow, "surrogate_report"):
+            try:
+                report["surrogate"] = workflow.surrogate_report(state)
+            except Exception as e:  # decoration must never sink the report
+                report["surrogate"] = {"error": f"{type(e).__name__}: {e}"}
     summary = recorder.summary() if recorder is not None else None
     if summary is not None:
         report["dispatch"] = summary

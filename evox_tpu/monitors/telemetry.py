@@ -57,6 +57,16 @@ class TelemetryState(PyTreeNode):
     # run; stays 0 for unguarded algorithms (picked up in post_step)
     restarts: jax.Array = field(sharding=P())
     last_trigger: jax.Array = field(sharding=P())
+    # surrogate mirror (workflows/surrogate.py, ISSUE 15): the TRUE
+    # evaluation count and triggered-fallback count of a screening
+    # SurrogateWorkflow driving this run — the headline `evals` counter
+    # above counts batch ROWS, which under screening includes the inert
+    # filled rows; these two make the real spend visible in report().
+    # Always materialized (zeros for every other workflow) so the state
+    # structure — and with it the checkpoint config fingerprint — never
+    # changes mid-run.
+    sur_true_evals: jax.Array = field(sharding=P(), default=None)
+    sur_fallback_gens: jax.Array = field(sharding=P(), default=None)
 
 
 class TelemetryMonitor(Monitor):
@@ -114,6 +124,8 @@ class TelemetryMonitor(Monitor):
             ring_diversity=jnp.full((K,), jnp.inf, dtype=jnp.float32),
             restarts=i32(),
             last_trigger=i32(),
+            sur_true_evals=i32(),
+            sur_fallback_gens=i32(),
         )
 
     # ------------------------------------------------------------------ hook
@@ -211,6 +223,8 @@ class TelemetryMonitor(Monitor):
             ring_diversity=upd(mstate.ring_diversity, diversity),
             restarts=mstate.restarts,  # owned by post_step (guardrail mirror)
             last_trigger=mstate.last_trigger,
+            sur_true_evals=mstate.sur_true_evals,  # owned by post_step
+            sur_fallback_gens=mstate.sur_fallback_gens,
         )
 
     def post_step(self, mstate: TelemetryState, wf_state: Any) -> TelemetryState:
@@ -221,9 +235,17 @@ class TelemetryMonitor(Monitor):
         workflows compile this hook to a no-op."""
         astate = getattr(wf_state, "algo", None)
         if hasattr(astate, "restarts") and hasattr(astate, "last_trigger"):
-            return mstate.replace(
+            mstate = mstate.replace(
                 restarts=jnp.asarray(astate.restarts, jnp.int32),
                 last_trigger=jnp.asarray(astate.last_trigger, jnp.int32),
+            )
+        # surrogate mirror (workflows/surrogate.py): structural
+        # detection, compiles to a no-op for every other workflow
+        sur = getattr(wf_state, "sur", None)
+        if hasattr(sur, "true_evals") and hasattr(sur, "fallback_gens"):
+            mstate = mstate.replace(
+                sur_true_evals=jnp.asarray(sur.true_evals, jnp.int32),
+                sur_fallback_gens=jnp.asarray(sur.fallback_gens, jnp.int32),
             )
         return mstate
 
@@ -324,6 +346,8 @@ class TelemetryMonitor(Monitor):
             "inf_fitness": int(mstate.inf_fitness),
             "restarts": int(mstate.restarts),
             "last_trigger": int(mstate.last_trigger),
+            "sur_true_evals": int(mstate.sur_true_evals),
+            "sur_fallback_gens": int(mstate.sur_fallback_gens),
             "capacity": self.capacity,
             "num_objectives": self.num_objectives,
             "trajectory": self.get_trajectory(mstate),

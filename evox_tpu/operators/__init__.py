@@ -1,5 +1,12 @@
-from . import selection, crossover, mutation, sampling, gaussian_process, sanitize
+from . import selection, crossover, mutation, sampling, gaussian_process, sanitize, surrogate
 from .sanitize import sanitize_bounds, validate_bound_handling, BOUND_METHODS
+from .surrogate import (
+    EnsembleSurrogate,
+    GPCapacityError,
+    GPSurrogate,
+    SurrogateArchive,
+    spearman_correlation,
+)
 
 __all__ = [
     "selection",
@@ -8,7 +15,13 @@ __all__ = [
     "sampling",
     "gaussian_process",
     "sanitize",
+    "surrogate",
     "sanitize_bounds",
     "validate_bound_handling",
     "BOUND_METHODS",
+    "SurrogateArchive",
+    "GPSurrogate",
+    "GPCapacityError",
+    "EnsembleSurrogate",
+    "spearman_correlation",
 ]

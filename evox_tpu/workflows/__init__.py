@@ -4,6 +4,7 @@ from .checkpoint import (
     restore_layouts,
 )
 from .std import StdWorkflow, StdWorkflowState
+from .surrogate import SurrogateWorkflow, SurrogateWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
 from .pipelined import run_host_pipelined
 from .journal import JournalIntegrityError, RunJournal
@@ -36,6 +37,8 @@ from .supervisor import (
 __all__ = [
     "StdWorkflow",
     "StdWorkflowState",
+    "SurrogateWorkflow",
+    "SurrogateWorkflowState",
     "IslandWorkflow",
     "IslandWorkflowState",
     "VectorizedWorkflow",

@@ -95,6 +95,47 @@ def fused_run(wf: Any, state: Any, n_steps: int) -> Any:
     return state
 
 
+def ingest_fitness(
+    wf: Any,
+    astate: Any,
+    mstates: list,
+    fitness: jax.Array,
+    use_init: bool,
+) -> Any:
+    """The tell half every workflow variant shares once the fitness is
+    FINAL (sign-flipped, quarantined/filled): fit_transforms → pre_tell
+    hook → ``init_tell``/``tell`` dispatch → the ``migrate_helper``
+    ``lax.cond`` → the end-of-step ``constrain_state`` boundary. One
+    body (used by StdWorkflow's step and pipelined tell and by
+    SurrogateWorkflow's screened variants) so a change to any of these
+    steps cannot silently drift between the copies."""
+    from ..core.distributed import constrain_state
+
+    for t in wf.fit_transforms:
+        fitness = t(fitness)
+    run_hooks(wf.monitors, wf._hook_table, "pre_tell", mstates, fitness)
+    if use_init:
+        astate = wf.algorithm.init_tell(astate, fitness)
+    else:
+        astate = wf.algorithm.tell(astate, fitness)
+    if wf.migrate_helper is not None:
+        do_migrate, foreign_pop, foreign_fit = wf.migrate_helper()
+        # foreign fitness arrives in the user's convention: sign-flip it
+        # to the internal minimization key, but never fit_transforms —
+        # population-relative shaping over a lone migrant batch is
+        # meaningless/NaN (see StdWorkflow.migrate_helper docs)
+        foreign_fit = wf._flip(foreign_fit)
+        astate = jax.lax.cond(
+            do_migrate,
+            lambda a: wf.algorithm.migrate(a, foreign_pop, foreign_fit),
+            lambda a: a,
+            astate,
+        )
+    # declared sharding + storage-dtype downcast in one fused walk: the
+    # loop-carried algorithm state leaves the step at storage width
+    return constrain_state(astate, wf.mesh, wf.dtype_policy)
+
+
 def quarantine_nonfinite(fitness: jax.Array) -> jax.Array:
     """Replace non-finite fitness entries with the worst FINITE value of
     the batch (internal minimization convention: the per-objective max),

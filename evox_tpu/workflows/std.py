@@ -36,7 +36,6 @@ from ..core.struct import PyTreeNode, static_field, field
 from ..core.distributed import (
     POP_AXIS as _POP_AXIS_NAME,
     all_gather,
-    constrain_state,
     shard_pop,
 )
 from ..core.dtype_policy import DtypePolicy, apply_compute, apply_storage
@@ -51,6 +50,7 @@ from .common import (
     callback_evaluate,
     finish_step,
     fused_run,
+    ingest_fitness,
     make_run_loop,
     quarantine_nonfinite,
     run_hooks,
@@ -658,28 +658,12 @@ class StdWorkflow:
         fitness = self._flip(fitness)
         if self.quarantine_nonfinite:
             fitness = quarantine_nonfinite(fitness)
-        for t in self.fit_transforms:
-            fitness = t(fitness)
-        self._run_hooks("pre_tell", mstates, fitness)
         use_init = state.first_step and (
             self.algorithm.has_init_ask or self.algorithm.has_init_tell
         )
-        if use_init:
-            astate = self.algorithm.init_tell(astate, fitness)
-        else:
-            astate = self.algorithm.tell(astate, fitness)
-        if self.migrate_helper is not None:
-            do_migrate, foreign_pop, foreign_fit = self.migrate_helper()
-            foreign_fit = self._flip(foreign_fit)
-            astate = jax.lax.cond(
-                do_migrate,
-                lambda a: self.algorithm.migrate(a, foreign_pop, foreign_fit),
-                lambda a: a,
-                astate,
-            )
-        # end-of-step boundary: declared sharding + storage-dtype downcast
-        # in one fused walk (core/distributed.constrain_state)
-        astate = constrain_state(astate, self.mesh, self.dtype_policy)
+        # shared tell half (workflows/common.py): fit_transforms ->
+        # pre_tell -> tell dispatch -> migrate cond -> constrain_state
+        astate = ingest_fitness(self, astate, mstates, fitness, use_init)
         self._run_hooks("post_tell", mstates)
         new_state = state.replace(
             generation=state.generation + 1,
@@ -718,33 +702,9 @@ class StdWorkflow:
             # AFTER monitors saw the raw fitness (telemetry still counts
             # them) and BEFORE fit_transforms/tell (ranking stays sane)
             fitness = quarantine_nonfinite(fitness)
-        for t in self.fit_transforms:
-            fitness = t(fitness)
-        self._run_hooks("pre_tell", mstates, fitness)
-
-        if use_init:
-            astate = self.algorithm.init_tell(astate, fitness)
-        else:
-            astate = self.algorithm.tell(astate, fitness)
-        if self.migrate_helper is not None:
-            do_migrate, foreign_pop, foreign_fit = self.migrate_helper()
-            # foreign fitness arrives in the user's convention: apply the
-            # sign flip so it meets the algorithm's internal minimization
-            # state — but NOT fit_transforms, which are population-relative
-            # (rank shaping over a lone migrant batch is meaningless/NaN)
-            foreign_fit = self._flip(foreign_fit)
-            astate = jax.lax.cond(
-                do_migrate,
-                lambda a: self.algorithm.migrate(a, foreign_pop, foreign_fit),
-                lambda a: a,
-                astate,
-            )
-
-        # apply per-field sharding annotations (field(sharding=...)) so the
-        # loop-carried algorithm state keeps its declared mesh layout; an
-        # active dtype policy downcasts storage-annotated leaves in the
-        # same walk — the carry leaves the step at storage width
-        astate = constrain_state(astate, self.mesh, self.dtype_policy)
+        # shared tell half (workflows/common.py): fit_transforms ->
+        # pre_tell -> tell dispatch -> migrate cond -> constrain_state
+        astate = ingest_fitness(self, astate, mstates, fitness, use_init)
         self._run_hooks("post_tell", mstates)
 
         new_state = state.replace(

@@ -1,0 +1,779 @@
+"""SurrogateWorkflow — trade cheap on-device FLOPs for expensive TRUE
+evaluations (ROADMAP item 5).
+
+For expensive problems — exactly the host-eval/rollout workloads PRs 2/5/8
+hardened — wall-clock is dominated by true evaluations, not device
+compute. This workflow wraps ANY single-objective algorithm and, each
+generation, pre-screens the full ask through an on-device surrogate
+(:mod:`evox_tpu.operators.surrogate`): only the top ``screen_frac``
+fraction by predicted fitness reaches the real problem; the unscreened
+rows are carried as INERT rows — filled with the worst FINITE truly
+evaluated value so they lose every comparison cleanly (the elastic
+``ACTIVE_ROWS``/worst-finite-fill precedent, workflows/elastic.py) — so
+one fixed-shape compiled program serves every generation with NO retrace
+as the screened count or the fallback state changes (the evaluated count
+is a traced operand under ``lax.cond``; ``screen_frac`` itself is static
+config).
+
+Health predicates (the :class:`~evox_tpu.core.guardrail.GuardedAlgorithm`
+precedent — jit predicates + ``lax.cond``, never a host branch): a
+generation falls back to FULL evaluation when the surrogate's
+rank-correlation between predicted and true fitness on the previously
+evaluated subset drops below ``rank_floor``, or its mean uncertainty /
+ensemble disagreement on the current ask exceeds ``unc_ceiling`` — a
+lying surrogate degrades to the bare workflow (plus surrogate overhead),
+never to a corrupted search. Every decision is counted on device and
+surfaced as the ``surrogate`` section of ``run_report()`` (schema v10,
+validated by tools/check_report.py).
+
+Refits: in fused/step runs the refit is a ``lax.cond`` at the
+``refit_every`` cadence inside the step. In executor-driven host runs
+(:class:`~evox_tpu.core.executor.GenerationExecutor`), the refit is a
+SEPARATE jitted program the executor dispatches between tells
+(``refit_due``/``dispatch_refit`` hooks): JAX's async dispatch means the
+generation loop never blocks on the Cholesky/adam program, and the model
+any ask consumes is fitted on an archive at most ``refit_every``
+generations stale — the executor's bounded-staleness discipline (PR 8)
+applied to the model instead of the tell. Both paths refit at the same
+absolute generations on the same archive contents, so checkpoint/resume
+reproduces the refit schedule deterministically.
+
+Disabled (``surrogate=None`` or ``screen_frac=1.0``) delegates every
+step/half to :class:`~evox_tpu.workflows.std.StdWorkflow` unchanged —
+BIT-identical to the bare workflow across step loops, fused runs, and
+the pipelined driver (asserted in tests/test_surrogate.py). Composes
+with quarantine, ``WorkflowCheckpointer``/resume (archive + model params
+are ordinary state leaves), the run supervisor's healing ladder, and
+``DtypePolicy`` (archived candidates rest at storage width).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.algorithm import Algorithm
+from ..core.distributed import POP_AXIS as _POP_AXIS_NAME, constrain_state, shard_pop
+from ..core.dtype_policy import apply_compute
+from ..core.problem import Problem
+from ..core.struct import PyTreeNode, field
+from ..operators.surrogate import (
+    SurrogateArchive,
+    spearman_correlation,
+)
+from .common import finish_step, ingest_fitness, quarantine_nonfinite
+from .std import StdWorkflow, StdWorkflowState
+
+__all__ = [
+    "FALLBACK_RANK",
+    "FALLBACK_UNCERTAINTY",
+    "SurrogateState",
+    "SurrogateWorkflow",
+    "SurrogateWorkflowState",
+    "masked_worst_finite_fill",
+]
+
+# bitmask codes recorded per fallback event (the guardrail trigger-bitmask
+# idiom, core/guardrail.py)
+FALLBACK_RANK = 1  # predicted/true rank correlation below rank_floor
+FALLBACK_UNCERTAINTY = 2  # mean uncertainty/disagreement above unc_ceiling
+
+
+class SurrogateState(PyTreeNode):
+    """The surrogate's slice of the workflow state: the paired archive,
+    the fitted model, the health/fallback flags, and the true-eval
+    ledger. Everything is a scalar or a small replicated buffer except
+    the nested archive/model states, which carry their own
+    capacity-leading ``P(POP_AXIS)`` annotations."""
+
+    archive: Any  # ArchiveState — own sharding/storage annotations
+    model: Any  # GPModelState / EnsembleModelState — own annotations
+    key: jax.Array = field(sharding=P())  # refit PRNG stream
+    refits: jax.Array = field(sharding=P())  # () int32
+    last_refit_gen: jax.Array = field(sharding=P())  # () int32
+    # health: set from the last evaluated subset, consumed next ask
+    fallback_next: jax.Array = field(sharding=P())  # () bool
+    last_rank_corr: jax.Array = field(sharding=P())  # () f32
+    last_uncertainty: jax.Array = field(sharding=P())  # () f32
+    # the true-evaluation ledger (int32 — the PR-1 counter bound)
+    candidates_seen: jax.Array = field(sharding=P())  # () rows asked
+    true_evals: jax.Array = field(sharding=P())  # () rows truly evaluated
+    screened_out: jax.Array = field(sharding=P())  # () rows never evaluated
+    generations: jax.Array = field(sharding=P())  # () screened-path gens
+    screened_gens: jax.Array = field(sharding=P())  # () gens that screened
+    fallback_gens: jax.Array = field(sharding=P())  # () triggered full-eval gens
+    warmup_gens: jax.Array = field(sharding=P())  # () pre-warm full-eval gens
+    # fallback event ring (generation, reason bitmask) — chronological
+    fb_gens: jax.Array = field(sharding=P())  # (log,) int32
+    fb_reasons: jax.Array = field(sharding=P())  # (log,) int32
+    fb_count: jax.Array = field(sharding=P())  # () int32
+
+
+class SurrogateWorkflowState(StdWorkflowState):
+    sur: Any = None
+
+
+class _ScreenPlan(NamedTuple):
+    """One generation's screening decision (all traced)."""
+
+    order: jax.Array  # (n,) evaluation order (identity under full eval)
+    n_eval: jax.Array  # () int32 rows to truly evaluate
+    full_eval: jax.Array  # () bool — this generation evaluates everything
+    warm: jax.Array  # () bool — archive filled and model fitted
+    mean_perm: jax.Array  # (n,) predicted fitness in evaluation order
+    unc_mean: jax.Array  # () mean uncertainty over the ask
+    reason: jax.Array  # () int32 fallback bitmask (0 = none/warmup)
+
+
+def masked_worst_finite_fill(fitness: jax.Array, eval_mask: jax.Array) -> jax.Array:
+    """Fill rows outside ``eval_mask`` with the worst FINITE truly
+    evaluated value — the quarantine/elastic inert-row discipline
+    (workflows/common.py ``quarantine_nonfinite`` / elastic's
+    ``ACTIVE_ROWS`` fill): an unscreened candidate loses every
+    comparison cleanly instead of poisoning argmin/ranking. Evaluated
+    rows pass through UNTOUCHED (a genuinely non-finite true fitness
+    stays visible to telemetry and to the quarantine opt-in exactly as
+    in the bare workflow). Single-objective (1-D) fitness."""
+    finite = eval_mask & jnp.isfinite(fitness)
+    worst = jnp.max(jnp.where(finite, fitness, -jnp.inf))
+    worst = jnp.where(jnp.isfinite(worst), worst, jnp.finfo(fitness.dtype).max)
+    return jnp.where(eval_mask, fitness, worst)
+
+
+class SurrogateWorkflow(StdWorkflow):
+    """Drive ANY single-objective algorithm with surrogate pre-screened
+    evaluation. Full :class:`StdWorkflow` API (``step``/``run``/
+    ``resume``/pipelined halves, checkpointer/supervisor/executor
+    composition); see the module docstring for the design.
+
+    Args:
+        algorithm / problem / **std_kwargs: as :class:`StdWorkflow`
+            (``eval_shard_map`` and ``num_objectives > 1`` are rejected
+            while screening is enabled — the evaluated subset is a
+            dynamic row slice, and the rank predicates are SO).
+        surrogate: a model with the ``init_model``/``fit``/``predict``
+            interface (:class:`~evox_tpu.operators.surrogate.GPSurrogate`
+            or :class:`~evox_tpu.operators.surrogate.EnsembleSurrogate`).
+            ``None`` disables screening entirely (bit-identical to the
+            bare workflow).
+        screen_frac: fraction of each ask that reaches the real problem
+            (per batch width: ``k = ceil(screen_frac * width)``, floored
+            at 1). ``1.0`` disables screening (bit-identical).
+        archive_capacity: paired-archive ring size. Default ``None``
+            derives 4x the widest ask width, rounded up to a multiple of
+            the mesh's pop-axis size; an explicit capacity must be at
+            least the widest ask width (one generation's scatter must
+            not collide with itself) and mesh-divisible.
+        warmup: archived pairs required before screening starts (until
+            then every generation fully evaluates and feeds the
+            archive). Default: the widest ask width (one generation).
+        refit_every: refit cadence in generations — the model's bounded
+            staleness (an ask consumes a model at most ``refit_every``
+            generations behind the archive).
+        rank_floor: Spearman rank-correlation floor between predicted
+            and true fitness on each generation's evaluated subset;
+            below it the NEXT generation falls back to full evaluation
+            (and keeps falling back until the correlation recovers —
+            full-eval generations re-measure it over the whole batch).
+        unc_ceiling: mean predictive-uncertainty ceiling over the ask;
+            above it THIS generation falls back. Default ``None`` (off:
+            the right scale is problem-dependent; the GP's posterior std
+            and the ensemble's disagreement are both in fitness units).
+        fallback_log: on-device fallback-event ring capacity (the
+            telemetry ring discipline — the last ``fallback_log`` events
+            are reported with generation + reason bitmask).
+    """
+
+    def __init__(
+        self,
+        algorithm: Algorithm,
+        problem: Problem,
+        surrogate: Any = None,
+        screen_frac: float = 1.0,
+        archive_capacity: Optional[int] = None,
+        warmup: Optional[int] = None,
+        refit_every: int = 1,
+        rank_floor: float = 0.5,
+        unc_ceiling: Optional[float] = None,
+        fallback_log: int = 64,
+        **std_kwargs: Any,
+    ):
+        if not (0.0 < screen_frac <= 1.0):
+            raise ValueError(
+                f"screen_frac must be in (0, 1], got {screen_frac}"
+            )
+        if refit_every < 1:
+            raise ValueError(f"refit_every must be >= 1, got {refit_every}")
+        if fallback_log < 1:
+            raise ValueError(f"fallback_log must be >= 1, got {fallback_log}")
+        if surrogate is not None:
+            for meth in ("init_model", "fit", "predict"):
+                if not callable(getattr(surrogate, meth, None)):
+                    raise TypeError(
+                        f"surrogate must implement {meth}(); got "
+                        f"{type(surrogate).__name__}"
+                    )
+        self.surrogate = surrogate
+        self.screen_frac = float(screen_frac)
+        self.refit_every = int(refit_every)
+        self.rank_floor = float(rank_floor)
+        self.unc_ceiling = (
+            float(unc_ceiling) if unc_ceiling is not None else None
+        )
+        self.fallback_log = int(fallback_log)
+        # screening active: delegation to the bare StdWorkflow trace is
+        # the disabled path's bit-identity mechanism, not an assumption
+        self._screening = surrogate is not None and self.screen_frac < 1.0
+        if self._screening:
+            if std_kwargs.get("num_objectives", 1) != 1:
+                raise ValueError(
+                    "surrogate screening is single-objective (the rank "
+                    "predicates and worst-finite fill are SO); disable "
+                    "screening for multi-objective runs"
+                )
+            if std_kwargs.get("eval_shard_map"):
+                raise ValueError(
+                    "surrogate screening cannot compose with "
+                    "eval_shard_map: the truly evaluated subset is a "
+                    "dynamic row slice the explicit-collective island "
+                    "cannot tile; use the default GSPMD evaluation path"
+                )
+        super().__init__(algorithm, problem, **std_kwargs)
+        self._sur_kwargs = dict(
+            surrogate=surrogate,
+            screen_frac=screen_frac,
+            archive_capacity=archive_capacity,
+            warmup=warmup,
+            refit_every=refit_every,
+            rank_floor=rank_floor,
+            unc_ceiling=unc_ceiling,
+            fallback_log=fallback_log,
+        )
+        if self._screening:
+            # screen_frac=1.0 keeps the surrogate config fully inert —
+            # no archive/model state is materialized, so the disabled
+            # state (and every monitor mirror) is structurally identical
+            # to the bare workflow's, not just value-identical
+            self._derive_shapes(archive_capacity, warmup)
+            self._refit = (
+                jax.jit(self._refit_impl) if self.jit_step else self._refit_impl
+            )
+
+    # ------------------------------------------------------------ shape prep
+    def _derive_shapes(
+        self, archive_capacity: Optional[int], warmup: Optional[int]
+    ) -> None:
+        astate_sds = jax.eval_shape(self.algorithm.init, jax.random.PRNGKey(0))
+        widths = []
+        ask_sds = jax.eval_shape(
+            lambda s: self.algorithm.ask(s)[0], astate_sds
+        )
+        probes = [ask_sds]
+        if self.algorithm.has_init_ask:
+            probes.append(
+                jax.eval_shape(
+                    lambda s: self.algorithm.init_ask(s)[0], astate_sds
+                )
+            )
+        for sds in probes:
+            if not hasattr(sds, "shape") or len(sds.shape) != 2:
+                raise ValueError(
+                    "surrogate screening requires flat 2-D (pop, dim) "
+                    f"candidates from ask; got {sds} — flatten the "
+                    "genotype before the workflow (pop_transforms map "
+                    "candidates AFTER screening) or disable screening"
+                )
+            widths.append(int(sds.shape[0]))
+        steady = widths[0]
+        if self._k_for(steady) >= steady:
+            # a screen_frac whose ceil rounds back up to the full batch
+            # would pay the surrogate cost forever while screening
+            # NOTHING — refuse loudly instead of running inert
+            raise ValueError(
+                f"screen_frac={self.screen_frac} screens nothing at the "
+                f"steady ask width {steady} "
+                f"(ceil(screen_frac * width) = {self._k_for(steady)} >= "
+                "width); lower screen_frac, or pass screen_frac=1.0 to "
+                "disable screening explicitly"
+            )
+        self._dim = int(probes[0].shape[1])
+        self._max_width = max(widths)
+        n_shards = (
+            int(self.mesh.shape[_POP_AXIS_NAME]) if self.mesh is not None else 1
+        )
+        if archive_capacity is None:
+            cap = 4 * self._max_width
+            cap += (-cap) % n_shards  # round up to mesh divisibility
+        else:
+            cap = int(archive_capacity)
+            if cap < self._max_width:
+                raise ValueError(
+                    f"archive_capacity {cap} is smaller than the widest "
+                    f"ask batch ({self._max_width}); one generation's "
+                    "scatter would collide with itself inside the ring"
+                )
+            if cap % n_shards != 0:
+                raise ValueError(
+                    f"archive_capacity {cap} is not divisible by the "
+                    f"mesh's '{_POP_AXIS_NAME}' axis ({n_shards} shards)"
+                )
+        check = getattr(self.surrogate, "check_capacity", None)
+        if check is not None:
+            check(cap)  # the GP's dense-scale guard, at construction
+        self._archive = SurrogateArchive(cap)
+        self._warmup = int(warmup) if warmup is not None else self._max_width
+
+    def clone_with_algorithm(self, algorithm: Algorithm) -> "SurrogateWorkflow":
+        # the IPOP rebuild point: capacity/warmup re-derive from the
+        # grown population when they were defaulted (raw args kept)
+        return SurrogateWorkflow(
+            algorithm, **dict(self._ctor_args, **self._sur_kwargs)
+        )
+
+    # ------------------------------------------------------------------ init
+    def init(self, key: jax.Array) -> SurrogateWorkflowState:
+        base = super().init(key)
+        sur = None
+        if self._screening:
+            cap = self._archive.capacity
+            zero = jnp.zeros((), jnp.int32)
+            log = self.fallback_log
+            sur = SurrogateState(
+                archive=self._archive.init(self._dim),
+                model=self.surrogate.init_model(cap, self._dim),
+                # the refit stream folds from a key DISJOINT from the
+                # algorithm/problem/monitor splits (the guardrail
+                # fold_in discipline keeps the delegated trajectory
+                # bit-identical to the bare workflow)
+                key=jax.random.fold_in(key, 0x5A6E),
+                refits=zero,
+                last_refit_gen=zero,
+                fallback_next=jnp.zeros((), bool),
+                last_rank_corr=jnp.ones((), jnp.float32),
+                last_uncertainty=jnp.zeros((), jnp.float32),
+                candidates_seen=zero,
+                true_evals=zero,
+                screened_out=zero,
+                generations=zero,
+                screened_gens=zero,
+                fallback_gens=zero,
+                warmup_gens=zero,
+                fb_gens=jnp.zeros((log,), jnp.int32),
+                fb_reasons=jnp.zeros((log,), jnp.int32),
+                fb_count=zero,
+            )
+        state = SurrogateWorkflowState(
+            generation=base.generation,
+            algo=base.algo,
+            prob=base.prob,
+            monitors=base.monitors,
+            first_step=True,
+            sur=sur,
+        )
+        from ..core.distributed import ensure_global_state
+
+        return ensure_global_state(state, self.mesh)
+
+    # ------------------------------------------------------------- screening
+    def _k_for(self, width: int) -> int:
+        return max(1, int(math.ceil(self.screen_frac * width)))
+
+    def _screen_plan(self, sur: SurrogateState, pop: jax.Array) -> _ScreenPlan:
+        if not (isinstance(pop, jax.Array) or hasattr(pop, "ndim")) or pop.ndim != 2:
+            raise ValueError(
+                "surrogate screening requires flat 2-D (pop, dim) "
+                f"candidates from ask; got shape {getattr(pop, 'shape', None)}"
+            )
+        n = pop.shape[0]
+        k = self._k_for(n)
+        mean, unc = self.surrogate.predict(
+            sur.model, jnp.asarray(pop, jnp.float32)
+        )
+        if k >= n:
+            # this batch width cannot screen (ceil(screen_frac * n) == n
+            # — e.g. a wider init_ask batch; the STEADY width is refused
+            # at construction): statically a non-screening generation —
+            # full evaluation counted under warmup_gens, never a
+            # fallback event (reason stays 0 and is never recorded)
+            warm = jnp.zeros((), bool)
+        else:
+            warm = (self._archive.fill(sur.archive) >= self._warmup) & (
+                sur.refits > 0
+            )
+        unc_mean = jnp.mean(unc)
+        ceiling = (
+            jnp.float32(self.unc_ceiling)
+            if self.unc_ceiling is not None
+            else jnp.float32(jnp.inf)
+        )
+        unc_trip = warm & (unc_mean > ceiling)
+        rank_trip = warm & sur.fallback_next
+        full_eval = (~warm) | rank_trip | unc_trip
+        order = jnp.where(
+            full_eval, jnp.arange(n), jnp.argsort(mean)
+        )
+        return _ScreenPlan(
+            order=order,
+            n_eval=jnp.where(full_eval, jnp.int32(n), jnp.int32(k)),
+            full_eval=full_eval,
+            warm=warm,
+            mean_perm=mean[order],
+            unc_mean=unc_mean,
+            reason=rank_trip.astype(jnp.int32) * FALLBACK_RANK
+            + unc_trip.astype(jnp.int32) * FALLBACK_UNCERTAINTY,
+        )
+
+    def _screened_evaluate(
+        self, pstate: Any, cand: Any, full_eval: jax.Array, k: int
+    ) -> Tuple[jax.Array, Any]:
+        """Evaluate either the leading ``k`` rows (screened; the rest
+        padded +inf and masked downstream) or the whole batch (fallback)
+        under ONE ``lax.cond`` — both branches live in the same compiled
+        program, so fallback flips never retrace."""
+        n = jax.tree.leaves(cand)[0].shape[0]
+        if k >= n:
+            return self._evaluate(pstate, cand)
+
+        def full(ps):
+            return self._evaluate(ps, cand)
+
+        def screened(ps):
+            sub = jax.tree.map(lambda x: x[:k], cand)
+            fit, ps2 = self._evaluate(ps, sub)
+            pad = jnp.full((n - k,), jnp.inf, fit.dtype)
+            return jnp.concatenate([fit, pad]), ps2
+
+        return jax.lax.cond(full_eval, full, screened, pstate)
+
+    def _refit_model(
+        self, sur: SurrogateState, archive: Any, gen_after: jax.Array
+    ):
+        """Fit on the given archive with the fold_in(key, generation)
+        stream — the ONE refit body both the fused step's ``lax.cond``
+        and the executor-dispatched ``_refit_impl`` call, so every
+        driver reproduces the same model at the same generation."""
+        return self.surrogate.fit(
+            sur.model,
+            jnp.asarray(archive.x, jnp.float32),
+            archive.y,
+            self._archive.valid_mask(archive),
+            jax.random.fold_in(sur.key, gen_after),
+        )
+
+    def _update_sur(
+        self,
+        sur: SurrogateState,
+        generation: jax.Array,
+        raw_perm: jax.Array,
+        flipped_perm: jax.Array,
+        eval_mask: jax.Array,
+        plan: _ScreenPlan,
+        refit_inline: bool,
+    ) -> SurrogateState:
+        gen_after = jnp.asarray(generation, jnp.int32) + 1
+        arch_mask = eval_mask & jnp.isfinite(flipped_perm)
+        archive = self._archive.update(
+            sur.archive, raw_perm, flipped_perm, arch_mask
+        )
+        # health: can the model ORDER what we truly measured?
+        corr = spearman_correlation(plan.mean_perm, flipped_perm, eval_mask)
+        trained = sur.refits > 0
+        fallback_next = trained & (corr < jnp.float32(self.rank_floor))
+        if refit_inline:
+            due = (gen_after % self.refit_every) == 0
+            model = jax.lax.cond(
+                due,
+                lambda: self._refit_model(sur, archive, gen_after),
+                lambda: sur.model,
+            )
+            refits = jnp.where(due, sur.refits + 1, sur.refits)
+            last_refit = jnp.where(due, gen_after, sur.last_refit_gen)
+        else:
+            # executor-driven host runs: the refit is a separate program
+            # dispatched between tells (dispatch_refit), same cadence
+            model, refits, last_refit = sur.model, sur.refits, sur.last_refit_gen
+        n = eval_mask.shape[0]
+        ev = plan.full_eval & plan.warm  # a TRIGGERED fallback, not warmup
+        slot = sur.fb_count % self.fallback_log
+        fb_gens = jnp.where(
+            ev,
+            jax.lax.dynamic_update_index_in_dim(
+                sur.fb_gens, gen_after, slot, 0
+            ),
+            sur.fb_gens,
+        )
+        fb_reasons = jnp.where(
+            ev,
+            jax.lax.dynamic_update_index_in_dim(
+                sur.fb_reasons, plan.reason, slot, 0
+            ),
+            sur.fb_reasons,
+        )
+        i32 = lambda b: b.astype(jnp.int32)  # noqa: E731
+        return SurrogateState(
+            archive=archive,
+            model=model,
+            key=sur.key,
+            refits=refits,
+            last_refit_gen=last_refit,
+            fallback_next=fallback_next,
+            last_rank_corr=corr,
+            last_uncertainty=plan.unc_mean,
+            candidates_seen=sur.candidates_seen + jnp.int32(n),
+            true_evals=sur.true_evals + plan.n_eval,
+            screened_out=sur.screened_out + (jnp.int32(n) - plan.n_eval),
+            generations=sur.generations + 1,
+            screened_gens=sur.screened_gens + i32(~plan.full_eval),
+            fallback_gens=sur.fallback_gens + i32(ev),
+            warmup_gens=sur.warmup_gens + i32(~plan.warm),
+            fb_gens=fb_gens,
+            fb_reasons=fb_reasons,
+            fb_count=sur.fb_count + i32(ev),
+        )
+
+    # ------------------------------------------------------- step (screened)
+    def _step_impl(self, state: SurrogateWorkflowState) -> SurrogateWorkflowState:
+        if not self._screening:
+            # disabled: the PARENT trace verbatim (sur rides along
+            # untouched) — the bit-identity mechanism, asserted in tests
+            return super()._step_impl(state)
+        state = apply_compute(state, self.dtype_policy)
+        mstates = list(state.monitors)
+        self._run_hooks("pre_step", mstates)
+        self._run_hooks("pre_ask", mstates)
+        use_init, pop, astate = self._dispatch_ask(state)
+        self._run_hooks("post_ask", mstates, pop)
+        plan = self._screen_plan(state.sur, pop)
+        raw_perm = jnp.asarray(pop, jnp.float32)[plan.order]
+        cand = pop[plan.order]
+        for t in self.pop_transforms:
+            cand = t(cand)
+        cand = shard_pop(cand, self.mesh)
+        self._run_hooks("pre_eval", mstates, cand)
+        n = pop.shape[0]
+        fitness_perm, pstate = self._screened_evaluate(
+            state.prob, cand, plan.full_eval, self._k_for(n)
+        )
+        fitness_perm = shard_pop(fitness_perm, self.mesh)
+        eval_mask = jnp.arange(n) < plan.n_eval
+        flipped = self._flip(fitness_perm)
+        filled = masked_worst_finite_fill(flipped, eval_mask)
+        # monitors observe the evaluation-order batch with inert rows
+        # already filled, in the user's direction convention (the flip
+        # is linear) — telemetry's eval counter counts batch ROWS; the
+        # true-evaluation ledger lives in the surrogate section
+        self._run_hooks("post_eval", mstates, cand, filled * self.opt_direction[0])
+        fit = filled
+        if self.quarantine_nonfinite:
+            fit = quarantine_nonfinite(fit)
+        fit = fit[jnp.argsort(plan.order)]  # back to ask order for tell
+        # shared tell half (workflows/common.py): fit_transforms ->
+        # pre_tell -> tell dispatch -> migrate cond -> constrain_state
+        astate = ingest_fitness(self, astate, mstates, fit, use_init)
+        sur = self._update_sur(
+            state.sur, state.generation, raw_perm, flipped, eval_mask, plan,
+            refit_inline=True,
+        )
+        sur = constrain_state(sur, self.mesh, self.dtype_policy)
+        self._run_hooks("post_tell", mstates)
+        new_state = state.replace(
+            generation=state.generation + 1,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(mstates),
+            first_step=False,
+            sur=sur,
+        )
+        return finish_step(self.monitors, self._hook_table, new_state)
+
+    # --------------------------------------------- pipelined halves (screened)
+    def _pipeline_ask_impl(self, state: SurrogateWorkflowState):
+        if not self._screening:
+            return super()._pipeline_ask_impl(state)
+        state = apply_compute(state, self.dtype_policy)
+        mstates = list(state.monitors)
+        self._run_hooks("pre_step", mstates)
+        self._run_hooks("pre_ask", mstates)
+        _, pop, astate = self._dispatch_ask(state)
+        self._run_hooks("post_ask", mstates, pop)
+        plan = self._screen_plan(state.sur, pop)
+        raw_perm = jnp.asarray(pop, jnp.float32)[plan.order]
+        cand = pop[plan.order]
+        for t in self.pop_transforms:
+            cand = t(cand)
+        cand = shard_pop(cand, self.mesh)
+        self._run_hooks("pre_eval", mstates, cand)
+        extra = (cand, raw_perm, plan)
+        # the host driver gets (candidates, rows-to-evaluate): only the
+        # leading n_eval rows reach the real problem (host_evaluate)
+        return (cand, plan.n_eval), (astate, tuple(mstates), extra)
+
+    def _pipeline_tell_impl(
+        self, state: SurrogateWorkflowState, ctx, fitness: jax.Array, pstate: Any
+    ) -> SurrogateWorkflowState:
+        if not self._screening:
+            return super()._pipeline_tell_impl(state, ctx, fitness, pstate)
+        astate, mstates_t, extra = ctx
+        cand, raw_perm, plan = extra
+        mstates = list(mstates_t)
+        fitness = shard_pop(jnp.asarray(fitness), self.mesh)
+        n = fitness.shape[0]
+        eval_mask = jnp.arange(n) < plan.n_eval
+        flipped = self._flip(fitness)
+        filled = masked_worst_finite_fill(flipped, eval_mask)
+        self._run_hooks(
+            "post_eval", mstates, cand, filled * self.opt_direction[0]
+        )
+        fit = filled
+        if self.quarantine_nonfinite:
+            fit = quarantine_nonfinite(fit)
+        fit = fit[jnp.argsort(plan.order)]
+        use_init = state.first_step and (
+            self.algorithm.has_init_ask or self.algorithm.has_init_tell
+        )
+        # shared tell half (workflows/common.py): fit_transforms ->
+        # pre_tell -> tell dispatch -> migrate cond -> constrain_state
+        astate = ingest_fitness(self, astate, mstates, fit, use_init)
+        # host-driven runs refit through the executor's dispatch_refit
+        # hook (refit_inline=False keeps THIS program refit-free so the
+        # cadence is owned in exactly one place per driver)
+        sur = self._update_sur(
+            state.sur, state.generation, raw_perm, flipped, eval_mask, plan,
+            refit_inline=False,
+        )
+        sur = constrain_state(sur, self.mesh, self.dtype_policy)
+        self._run_hooks("post_tell", mstates)
+        new_state = state.replace(
+            generation=state.generation + 1,
+            algo=astate,
+            prob=pstate,
+            monitors=tuple(mstates),
+            first_step=False,
+            sur=sur,
+        )
+        return finish_step(self.monitors, self._hook_table, new_state)
+
+    # ------------------------------------------------- executor host hooks
+    def host_evaluate(self, pstate: Any, cand: Any, eval_chunk: Optional[int]):
+        """The :class:`~evox_tpu.core.executor.GenerationExecutor`'s
+        host-evaluation hook: slice the screened batch to its truly
+        evaluated rows (a HOST slice — the jitted halves stay one fixed
+        shape), evaluate only those (honoring the supervisor's
+        ``eval_chunk`` degradation), and pad the fitness back to the
+        declared width with +inf sentinels the tell half masks out. The
+        whole point of the workflow: the expensive host problem sees
+        ``n_eval`` rows, not ``pop``."""
+        from .pipelined import chunked_evaluate
+
+        if not self._screening:
+            return chunked_evaluate(self.problem, pstate, cand, eval_chunk)
+        cand_arr, n_eval = cand
+        n = int(n_eval)  # small scalar fetch, the CLAUDE.md-legal kind
+        width = jax.tree.leaves(cand_arr)[0].shape[0]
+        part = jax.tree.map(lambda x: x[:n], cand_arr)
+        fit, ps = chunked_evaluate(self.problem, pstate, part, eval_chunk)
+        if n >= width:
+            return fit, ps
+        if isinstance(fit, jax.Array):
+            pad = jnp.full((width - n,), jnp.inf, fit.dtype)
+            return jnp.concatenate([fit, pad]), ps
+        fit = np.asarray(fit)
+        pad = np.full((width - n,), np.inf, fit.dtype)
+        return np.concatenate([fit, pad]), ps
+
+    def refit_due(self, generation: int) -> bool:
+        """Host-side cadence predicate the executor polls after each
+        tell — pure in the absolute generation, so a resumed run
+        reproduces the schedule deterministically."""
+        return (
+            self._screening
+            and generation >= 1
+            and generation % self.refit_every == 0
+        )
+
+    def dispatch_refit(self, state: Any, generation: int) -> Any:
+        """Refit the model on the current archive as ONE separate jitted
+        program (async dispatch — the executor's loop never blocks on
+        it) and splice the result into the state. Same fit body and
+        fold_in stream as the fused step's inline ``lax.cond`` refit, at
+        the same absolute generations."""
+        return state.replace(
+            sur=self._refit(state.sur, jnp.asarray(generation, jnp.int32))
+        )
+
+    def _refit_impl(self, sur: SurrogateState, gen: jax.Array) -> SurrogateState:
+        # gen is the post-tell generation: match the inline path's
+        # _refit_model(sur, archive, gen_after) exactly (archive already
+        # updated by the tell that preceded this dispatch)
+        return sur.replace(
+            model=self._refit_model(sur, sur.archive, gen),
+            refits=sur.refits + 1,
+            last_refit_gen=gen,
+        )
+
+    # ------------------------------------------------------------- reporting
+    def surrogate_report(self, state: Any) -> dict:
+        """The ``surrogate`` section of ``run_report()`` (schema v10,
+        validated by tools/check_report.py): archive fill, refit
+        count/staleness, the screened-vs-true eval ledger, health
+        readings, and the chronological fallback-event log."""
+        from ..core.instrument import sanitize_json
+
+        out: dict = {
+            "enabled": bool(self._screening),
+            "model": getattr(self.surrogate, "kind", None)
+            if self.surrogate is not None
+            else None,
+            "screen_frac": self.screen_frac,
+        }
+        sur = getattr(state, "sur", None)
+        if sur is None or not self._screening:
+            return sanitize_json(out)
+        cap = self._archive.capacity
+        count = int(sur.fb_count)
+        log = self.fallback_log
+        n_ev = min(count, log)
+        slots = [(i % log) for i in range(count - n_ev, count)]
+        gens = np.asarray(sur.fb_gens)
+        reasons = np.asarray(sur.fb_reasons)
+        out.update(
+            archive={
+                "capacity": cap,
+                "fill": int(self._archive.fill(sur.archive)),
+                "writes": int(sur.archive.count),
+            },
+            refit={
+                "count": int(sur.refits),
+                "every": self.refit_every,
+                "last_generation": int(sur.last_refit_gen),
+                # the model any ask consumes is fitted on an archive at
+                # most this many generations old — the staleness bound
+                "max_staleness_gens": self.refit_every,
+            },
+            counters={
+                "candidates_seen": int(sur.candidates_seen),
+                "true_evals": int(sur.true_evals),
+                "screened_out": int(sur.screened_out),
+                "generations": int(sur.generations),
+                "screened_gens": int(sur.screened_gens),
+                "fallback_gens": int(sur.fallback_gens),
+                "warmup_gens": int(sur.warmup_gens),
+            },
+            health={
+                "rank_floor": self.rank_floor,
+                "unc_ceiling": self.unc_ceiling,
+                "last_rank_corr": float(sur.last_rank_corr),
+                "last_uncertainty": float(sur.last_uncertainty),
+                "fallback_armed": bool(sur.fallback_next),
+            },
+            fallback_events=[
+                {"generation": int(gens[s]), "reason": int(reasons[s])}
+                for s in slots
+            ],
+        )
+        return sanitize_json(out)

@@ -239,6 +239,48 @@ class FlakyDispatch:
 
 
 # --------------------------------------------------------------------------
+# surrogate fault injection (ISSUE 15)
+
+
+class LyingSurrogate:
+    """Wrap any surrogate model and systematically LIE at predict time:
+    the predicted mean is negated (the model's ordering becomes exactly
+    wrong) and the reported uncertainty is scaled toward overconfidence.
+    ``fit`` and state management delegate unchanged, so the lie is pure
+    prediction-layer poison — the deterministic trigger for
+    SurrogateWorkflow's rank-correlation fallback predicate
+    (tests/test_surrogate.py asserts the fallback fires AND the guarded
+    run still converges, because fallback == full evaluation)."""
+
+    def __init__(self, inner, lie_after: int = 0):
+        self.inner = inner
+        self.kind = inner.kind
+        self.lie_after = lie_after
+        self.predict_calls = 0
+
+    def check_capacity(self, capacity: int) -> None:
+        check = getattr(self.inner, "check_capacity", None)
+        if check is not None:
+            check(capacity)
+
+    def init_model(self, capacity: int, dim: int):
+        return self.inner.init_model(capacity, dim)
+
+    def fit(self, model, x, y, mask, key=None):
+        return self.inner.fit(model, x, y, mask, key)
+
+    def predict(self, model, x_test):
+        # NOTE: traced once per compiled program — the lie must be
+        # unconditional in traced code, so `lie_after` only gates
+        # whether the POISONED trace is built at all (0 = always lie)
+        self.predict_calls += 1
+        mean, unc = self.inner.predict(model, x_test)
+        if self.predict_calls > self.lie_after:
+            return -mean, unc * 1e-3
+        return mean, unc
+
+
+# --------------------------------------------------------------------------
 # numeric (algorithm-state) fault injection
 
 

@@ -527,3 +527,161 @@ def test_validator_journal_pod_kinds():
     journal["events"] = {"pod_join": 2, "pod_detonate": 1}
     errors = "\n".join(check_report._validate_journal(journal, "t"))
     assert "pod_detonate" in errors
+
+
+def _surrogate_section():
+    return {
+        "enabled": True,
+        "model": "gp",
+        "screen_frac": 0.125,
+        "archive": {"capacity": 256, "fill": 128, "writes": 128},
+        "refit": {
+            "count": 8,
+            "every": 1,
+            "last_generation": 8,
+            "max_staleness_gens": 1,
+        },
+        "counters": {
+            "candidates_seen": 512,
+            "true_evals": 128,
+            "screened_out": 384,
+            "generations": 8,
+            "screened_gens": 6,
+            "fallback_gens": 1,
+            "warmup_gens": 1,
+        },
+        "health": {
+            "rank_floor": 0.3,
+            "unc_ceiling": None,
+            "last_rank_corr": 0.9,
+            "last_uncertainty": 0.1,
+            "fallback_armed": False,
+        },
+        "fallback_events": [{"generation": 5, "reason": 1}],
+    }
+
+
+def test_validator_v10_surrogate_section_rules():
+    """The v10 surrogate section: a coherent ledger passes; a broken
+    counter sum, an over-full archive, out-of-order events, and unknown
+    reason bits all fail loudly."""
+    good = {
+        "schema": "evox_tpu.run_report/v10",
+        "surrogate": _surrogate_section(),
+    }
+    assert check_report.validate_run_report(good) == []
+    # disabled sections stay minimal and valid
+    assert check_report.validate_run_report(
+        {
+            "schema": "evox_tpu.run_report/v10",
+            "surrogate": {"enabled": False, "model": None, "screen_frac": 1.0},
+        }
+    ) == []
+
+    bad = json.loads(json.dumps(good))
+    bad["surrogate"]["counters"]["screened_out"] = 1
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "candidates_seen" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["surrogate"]["counters"]["warmup_gens"] = 5
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "partition" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["surrogate"]["archive"]["fill"] = 400
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "capacity" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["surrogate"]["fallback_events"] = [
+        {"generation": 5, "reason": 1},
+        {"generation": 3, "reason": 2},
+    ]
+    bad["surrogate"]["counters"]["fallback_gens"] = 2
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "chronological" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["surrogate"]["fallback_events"][0]["reason"] = 8
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "bitmask" in errors
+
+    bad = json.loads(json.dumps(good))
+    bad["surrogate"]["fallback_events"] = [
+        {"generation": 2, "reason": 1},
+        {"generation": 5, "reason": 1},
+    ]  # two events but only 1 fallback generation counted
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "fallback" in errors
+
+
+def test_validator_v10_surrogate_bench_rules():
+    """Bench rules: the surrogate leg must carry vs_baseline +
+    ratio_rounds; the `surrogate` summary key needs a coherent eval
+    ledger hitting the 5x bar (note escape honored), anchored to an
+    instrumented run_report whose counters agree."""
+    leg = {
+        "leg": "surrogate",
+        "metric": "Surrogate-screened candidate throughput (...)",
+        "value": 3000.0,
+        "unit": "cand-evals/sec",
+        "vs_baseline": 6.5,
+        "ratio_rounds": [6.4, 6.5, 6.6],
+    }
+    rr = {
+        "schema": "evox_tpu.run_report/v10",
+        "surrogate": _surrogate_section(),
+    }
+    summary = {
+        "metric": "m",
+        "value": 1.0,
+        "unit": "x",
+        "sub_metrics": [leg],
+        "surrogate": {
+            "eval_ledger": {
+                "threshold": 1e-2,
+                "screened": {"true_evals": 128, "generations": 8, "best": 5e-3},
+                "full": {"true_evals": 768, "generations": 6, "best": 6e-3},
+                "ratio": 6.0,
+            },
+            "run_report": rr,
+        },
+    }
+    assert check_report.validate_bench(summary) == []
+
+    bad = json.loads(json.dumps(summary))
+    bad["sub_metrics"][0]["vs_baseline"] = None
+    bad["sub_metrics"][0]["ratio_rounds"] = None
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "full-evaluation baseline ratio" in errors
+    assert "ratio_rounds" in errors
+
+    bad = json.loads(json.dumps(summary))
+    bad["surrogate"]["eval_ledger"]["ratio"] = 3.0
+    bad["surrogate"]["eval_ledger"]["full"]["true_evals"] = 384
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "5x" in errors
+    bad["surrogate"]["note"] = "containerized capture: see protocol"
+    assert check_report.validate_bench(bad) == []
+
+    bad = json.loads(json.dumps(summary))
+    bad["surrogate"]["eval_ledger"]["ratio"] = 9.0
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "incoherent" in errors
+
+    bad = json.loads(json.dumps(summary))
+    bad["surrogate"]["eval_ledger"]["screened"]["best"] = 0.5
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "did not reach the threshold" in errors
+
+    bad = json.loads(json.dumps(summary))
+    bad["surrogate"]["run_report"]["surrogate"]["counters"]["true_evals"] = 99
+    bad["surrogate"]["run_report"]["surrogate"]["counters"]["screened_out"] = 413
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "disagree" in errors
+
+    bad = json.loads(json.dumps(summary))
+    del bad["surrogate"]["run_report"]
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "machine-validated" in errors

@@ -529,7 +529,7 @@ def test_executor_section_and_trace_validate(tmp_path):
     s = wf.init(jax.random.PRNGKey(4))
     s = ex.run_host(wf, s, 6)
     rep = run_report(wf, s, recorder=rec)
-    assert rep["schema"].endswith("/v9")
+    assert rep["schema"].endswith("/v10")
     assert rep["executor"]["counters"]["tells"] == 6
     assert rep["executor"]["overlap"]["wall_s"] > 0
     assert check_report.validate_run_report(rep) == []

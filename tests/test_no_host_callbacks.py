@@ -285,6 +285,21 @@ def test_multihost_modules_are_callback_free():
         assert (PKG / rel).exists(), f"{rel} missing"
         assert rel not in users, f"{rel} must not use host callbacks"
 
+def test_surrogate_modules_are_callback_free():
+    """The ISSUE-15 surrogate layer must hold the axon constraint by
+    construction: the archive scatter, GP Cholesky, ensemble training
+    loop, screening cond, and fallback predicates are all pure jittable
+    math inside the step, and the workflow's host hooks (host_evaluate,
+    dispatch_refit) are eager host orchestration between dispatches — a
+    host callback in either module would make surrogate screening
+    unusable on the tunneled TPU whose evaluation cost it exists to
+    cut."""
+    users = _scan()
+    for rel in ("operators/surrogate.py", "workflows/surrogate.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"
+
+
 def test_pod_supervisor_module_is_callback_free():
     """The ISSUE-14 pod fault domain must hold the axon constraint by
     construction: heartbeats, censuses, watchdog deadlines, drain

@@ -417,6 +417,53 @@ def test_sharded_step_contract(name):
         )
 
 
+def test_surrogate_state_contracts():
+    """ISSUE 15 (operators/surrogate.py + workflows/surrogate.py): the
+    paired archive's capacity-leading buffers are the shardable axis —
+    ``P(POP_AXIS)`` with candidates ``storage=True`` (bf16-storage-
+    compatible) and fitness/factorization products explicitly
+    ``storage=False`` (must-stay-f32); every scalar/replicated field is
+    ``P()``. Checked with the same mechanical walker as the algorithm
+    states, with ``pop`` = the archive capacity (the leading axis the
+    convention keys on); the full SurrogateState (archive + model
+    nested) passes the same walk."""
+    from evox_tpu.operators.surrogate import (
+        EnsembleSurrogate,
+        GPSurrogate,
+        SurrogateArchive,
+    )
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows.surrogate import SurrogateWorkflow
+    from evox_tpu.algorithms.so.pso import PSO
+
+    cap, dim = 16, 3
+    arc = SurrogateArchive(cap)
+    _check_state(arc.init(dim), "ArchiveState", pop=cap)
+    _check_state(
+        GPSurrogate().init_model(cap, dim), "GPModelState", pop=cap
+    )
+    # the ensemble's member axis must NOT read as the population axis:
+    # pick a member count that differs from every leaf dimension
+    ens = EnsembleSurrogate(n_members=2, hidden=7, fit_steps=1)
+    _check_state(ens.init_model(cap, dim), "EnsembleModelState", pop=cap)
+    # the assembled workflow-state slice, after real steps (fitted model)
+    wf = SurrogateWorkflow(
+        PSO(lb=-jnp.ones(dim), ub=jnp.ones(dim), pop_size=8),
+        Sphere(),
+        surrogate=GPSurrogate(),
+        screen_frac=0.5,
+        archive_capacity=cap,
+        warmup=8,
+        refit_every=1,
+        # a log size that is NOT a multiple of cap, so the event ring
+        # cannot be misread as capacity-leading by the walker
+        fallback_log=5,
+    )
+    state = wf.init(jax.random.PRNGKey(0))
+    state = wf.step(wf.step(state))
+    _check_state(state.sur, "SurrogateState", pop=cap)
+
+
 def test_monitor_state_contracts():
     """Monitor states: frozen pytree dataclasses, all fields P() (their
     buffers are capacity-leading, not population-leading)."""
