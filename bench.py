@@ -1522,6 +1522,68 @@ def telemetry_report(trace_path=None):
     return report
 
 
+# ---------------------------------------------------------------- workload 12
+# The metrics-plane overhead A/B (PR 16): the SAME CSO workload as the
+# geomean leg, driven through GenerationExecutor.run_fused at the
+# serving cadence — one fused dispatch per chunk followed by the
+# RunQueue's per-chunk bookkeeping (registry counts + ONE durable
+# fsynced `sample` record into a real FlightRecorder stream) — against
+# OUR OWN drive of the IDENTICAL chunked loop with metrics=None (the
+# exact-no-op contract). Both sides OURS: excluded from the geomean.
+# vs_baseline = bare/instrumented wall ratio; the PR-16 overhead law is
+# ratio >= 0.98 (<= 2% wall), PERF_NOTES §27 records the measured
+# number. The per-chunk dispatch count is identical on both sides, so
+# the differenced slope isolates the metrics plane, not tunnel latency.
+
+MET_CHUNK = 100  # generations per dispatch chunk (one sample per chunk)
+MET_PAIR = (100, 600)  # fused-generation trip counts (MET_CHUNK multiples)
+
+
+def _cso_metrics_measurer(fr):
+    from evox_tpu import GenerationExecutor, StdWorkflow
+    from evox_tpu.algorithms.so.pso import CSO
+    from evox_tpu.problems.numerical import Ackley
+
+    algo = CSO(
+        lb=-32.0 * jnp.ones(CSO_DIM),
+        ub=32.0 * jnp.ones(CSO_DIM),
+        pop_size=CSO_POP,
+    )
+    wf = StdWorkflow(algo, Ackley())
+    state = wf.init(jax.random.PRNGKey(42))
+    ex = GenerationExecutor(metrics=fr)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        s = state
+        for k in range(n // MET_CHUNK):
+            s = ex.run_fused(wf, s, MET_CHUNK)
+            if fr is not None:
+                fr.count("slo.tenant_gens", MET_CHUNK)
+                fr.sample(generation=(k + 1) * MET_CHUNK)
+        _fetch(s)
+        return time.perf_counter() - t0
+
+    for n in MET_PAIR:
+        timed(n)  # compile + warm both trip counts
+    return _differenced(timed, *MET_PAIR)
+
+
+def bench_cso_metrics_instrumented():
+    import tempfile
+
+    from evox_tpu.workflows.flightrec import FlightRecorder
+
+    fr = FlightRecorder(
+        directory=tempfile.mkdtemp(prefix="evox_bench_metrics_")
+    )
+    return _cso_metrics_measurer(fr), CSO_POP
+
+
+def bench_cso_metrics_bare():
+    return _cso_metrics_measurer(None), CSO_POP
+
+
 # ----------------------------------------------------------------------- main
 
 # Analytic roofline estimates per unit of the workload's metric (one eval,
@@ -1751,6 +1813,20 @@ WORKLOADS = [
         bench_surrogate_fulleval,
         ROOFLINES["surrogate"],
     ),
+    (
+        "metrics_overhead",
+        f"CSO/Ackley metrics-plane overhead evals/sec (pop={CSO_POP}, "
+        f"dim={CSO_DIM}, run_fused at {MET_CHUNK} gens/dispatch with a "
+        "live FlightRecorder: registry counts + one durable fsynced "
+        "sample per chunk; 'baseline' is the IDENTICAL chunked drive "
+        "with metrics=None, NOT the reference — excluded from the "
+        "geomean. vs_baseline = bare/instrumented wall ratio; the "
+        "PR-16 overhead law wants >= 0.98, i.e. <= 2% wall)",
+        "evals/sec",
+        bench_cso_metrics_instrumented,
+        bench_cso_metrics_bare,
+        ROOFLINES["cso"],
+    ),
 ]
 
 # legs whose "baseline" is not the reference: reported, never geomeaned.
@@ -1764,6 +1840,7 @@ NON_REFERENCE_BUILDERS = {
     bench_hosteval_overlapped,  # A/B against OUR serialized step loop
     bench_large_pop_sharded,  # A/B against OUR replicated sampling law
     bench_surrogate_screened,  # A/B against OUR full-evaluation workflow
+    bench_cso_metrics_instrumented,  # A/B against OUR bare chunked drive
 }
 NON_REFERENCE_LEGS = {
     metric for _, metric, _, ours_fn, _, _ in WORKLOADS

@@ -216,6 +216,11 @@ class ExecutableCache:
         self.bytes_written = 0
         self.bytes_read = 0
         self.entries: List[dict] = []  # per-key provenance, report() order
+        # serving-plane flight recorder (PR 16): attach with
+        # `cache.metrics = rec` (RunQueue auto-threads its recorder) to
+        # mirror hit/miss/compile-ms into the live metrics plane; None
+        # (default) changes nothing
+        self.metrics: Any = None
 
     # -------------------------------------------------------------- keying
     @staticmethod
@@ -445,6 +450,8 @@ class ExecutableCache:
             del self._mem[key]
             self._mem[key] = compiled
             self.counters["hits"] += 1
+            if self.metrics is not None:
+                self.metrics.count("exec_cache.hits")
             return compiled
         if self.directory is not None:
             got = self._load_disk(key, mesh)
@@ -453,6 +460,8 @@ class ExecutableCache:
                 self._mem_put(key, compiled)
                 self.counters["disk_hits"] += 1
                 self.compile_s_saved += float(manifest.get("compile_s") or 0.0)
+                if self.metrics is not None:
+                    self.metrics.count("exec_cache.disk_hits")
                 self._note_entry(
                     {
                         "key": key[:16],
@@ -479,6 +488,9 @@ class ExecutableCache:
         compiled = lowerable.lower(*args, **kwargs).compile()
         compile_s = time.perf_counter() - t0
         self.compile_s_paid += compile_s
+        if self.metrics is not None:
+            self.metrics.count("exec_cache.misses")
+            self.metrics.observe("exec_cache.compile_ms", compile_s * 1e3)
         nbytes = None
         if self.directory is not None:
             nbytes = self._save_disk(

@@ -242,6 +242,7 @@ class GenerationExecutor:
         pod_supervisor: Any = None,
         fetch_monitors_every: Optional[int] = None,
         clock: Callable[[], float] = time.perf_counter,
+        metrics: Any = None,
     ):
         if max_staleness < 0:
             raise ValueError(f"max_staleness must be >= 0, got {max_staleness}")
@@ -259,6 +260,12 @@ class GenerationExecutor:
         # the coordinated SIGTERM drain. None (default) changes nothing.
         self.pod_supervisor = pod_supervisor
         self.fetch_monitors_every = fetch_monitors_every
+        # serving-plane flight recorder (PR 16, workflows/flightrec.py):
+        # when attached (constructor or `executor.metrics = rec` — the
+        # RunQueue auto-threads its recorder), _sample mirrors the
+        # counter tracks as gauges and _timed_dispatch observes dispatch
+        # latency into a histogram. None (default) changes nothing.
+        self.metrics = metrics
         self._clock = clock
         self._created = clock()
         self._lock = threading.Lock()
@@ -322,6 +329,10 @@ class GenerationExecutor:
             samples = self._counter_samples[track]
             if len(samples) < _MAX_COUNTER_SAMPLES:
                 samples.append((self._clock(), float(value)))
+        if self.metrics is not None:
+            # "executor/io_queue_depth" -> "executor.io_queue_depth":
+            # metric names are dotted, trace tracks slash-separated
+            self.metrics.set(track.replace("/", "."), float(value))
 
     def _timed_dispatch(self, name: str, fn: Callable[[], Any]) -> Any:
         t0 = self._clock()
@@ -331,6 +342,9 @@ class GenerationExecutor:
             dt = self._clock() - t0
             self.overlap["device_dispatch_s"] += dt
             self._span("device", name, t0, dt)
+            if self.metrics is not None:
+                self.metrics.count("executor.dispatches")
+                self.metrics.observe("executor.dispatch_ms", dt * 1e3)
 
     # ---------------------------------------------------------------- report
     def report(self) -> dict:
@@ -1014,8 +1028,17 @@ class GenerationExecutor:
             t0 = self._clock()
             host = jax.device_get(monitors)
             self.last_monitor_fetch = (gen, host)
-            self._span("io:fetch", "monitors", t0, self._clock() - t0,
-                       generation=gen)
+            dt = self._clock() - t0
+            self._span("io:fetch", "monitors", t0, dt, generation=gen)
+            if self.metrics is not None:
+                # the telemetry lane is the axon-legal path from the
+                # on-device rings into the metrics plane: the fetch just
+                # completed on a background thread (registry is
+                # thread-safe), so the gauges carry the newest ring
+                # values without any callback or extra round-trip
+                self.metrics.count("executor.monitor_fetches")
+                self.metrics.observe("executor.monitor_fetch_ms", dt * 1e3)
+                self.metrics.set("executor.monitor_fetch_gen", gen)
 
         lane.submit(fetch)
         self._sample("executor/io_queue_depth", lane.depth())

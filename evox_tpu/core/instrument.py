@@ -621,6 +621,7 @@ def run_report(
     supervisor: Any = None,
     executor: Any = None,
     pod_supervisor: Any = None,
+    metrics: Any = None,
 ) -> dict:
     """Merge device telemetry and host dispatch timings into ONE
     JSON-serializable dict.
@@ -676,8 +677,18 @@ def run_report(
     # section (ISSUE 15, workflows/surrogate.py: archive fill, refit
     # count/staleness, the screened-vs-true eval ledger, health
     # readings, chronological fallback events) — validated when present,
-    # incl. the counter-sum and event-ordering coherence rules.
-    report: dict = {"schema": "evox_tpu.run_report/v10"}
+    # incl. the counter-sum and event-ordering coherence rules. v11 adds
+    # the top-level `schema_version` int (PR 16 satellite: the version
+    # is grep-able without parsing the schema string; check_report
+    # --schema prints the validated range) and the optional `metrics` +
+    # `slo` sections (workflows/flightrec.py FlightRecorder: the
+    # serving-plane registry snapshot, stream accounting, and the SLO
+    # ledger) — validated when present, incl. slo↔tenancy.queue
+    # counter coherence.
+    report: dict = {
+        "schema": "evox_tpu.run_report/v11",
+        "schema_version": 11,
+    }
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
     if workflow is not None and state is not None:
@@ -818,6 +829,18 @@ def run_report(
         executor = getattr(workflow, "_run_executor", None)
     if executor is not None and hasattr(executor, "report"):
         report["executor"] = executor.report()
+    # serving-plane flight recorder (schema v11, workflows/flightrec.py):
+    # a metrics-instrumented serving stack advertises its recorder as
+    # `_flight_recorder` (the RunQueue backref) — the registry snapshot
+    # and stream accounting become the `metrics` section and the SLO
+    # ledger a first-class top-level `slo` section (duck-typed like the
+    # supervisor pickups; core never imports the workflows package)
+    if metrics is None and workflow is not None:
+        metrics = getattr(workflow, "_flight_recorder", None)
+    if metrics is not None and hasattr(metrics, "report"):
+        report["metrics"] = metrics.report()
+        if hasattr(metrics, "slo_ledger"):
+            report["slo"] = metrics.slo_ledger()
     if extra:
         report["extra"] = dict(extra)
     return sanitize_json(report)
@@ -832,6 +855,16 @@ def write_report_jsonl(report: dict, path: str) -> None:
 # ------------------------------------------------------------ chrome trace
 
 _US = 1e6  # trace-event timestamps are microseconds
+
+
+#: trace pids are ``PID_STRIDE * jax_process_index + local track``:
+#: track 0 = host dispatch, 1 = device telemetry, 2 = host counters,
+#: 3 = run supervisor, 4 = generation executor, 5 = pod supervisor.
+#: workflows/flightrec.py shares the stride (its metrics tracks start at
+#: the same base), so per-process traces from ``dryrun_multihost`` land
+#: on disjoint, deterministic pid ranges and can be concatenated or
+#: merged without collision.
+PID_STRIDE = 100
 
 
 def _counter_events(
@@ -866,6 +899,7 @@ def write_chrome_trace(
     supervisor: Any = None,
     executor: Any = None,
     pod_supervisor: Any = None,
+    process_index: Optional[int] = None,
 ) -> dict:
     """Export a run as Chrome trace-event JSON (open in Perfetto or
     chrome://tracing) and return the trace dict.
@@ -901,6 +935,14 @@ def write_chrome_trace(
       their true host timestamps, plus queue-depth and stale-lag counter
       tracks.
 
+    Every process gets ``process_name``/``thread_name`` metadata events
+    and a deterministic pid: ``pid = PID_STRIDE * jax_process_index +
+    track`` (track 0-5 per the :data:`PID_STRIDE` table).
+    ``process_index`` defaults to the active ``jax.distributed`` process
+    id (0 outside a pod), so per-worker traces from ``dryrun_multihost``
+    land on disjoint pid ranges with names like ``"p1: host dispatch"``
+    instead of colliding anonymously.
+
     Entirely host-side (no callbacks, axon-safe): everything exported was
     already recorded outside traced code.
     """
@@ -908,12 +950,26 @@ def write_chrome_trace(
     t0 = recorder._created if recorder is not None else 0.0
     t_end = t0
 
-    def meta(pid: int, name: str, tid: Optional[int] = None) -> dict:
+    if process_index is None:
+        try:
+            from .distributed import _dist_process_info
+
+            process_index, _ = _dist_process_info()
+        except Exception:
+            process_index = 0
+    process_index = int(process_index)
+    pid_base = PID_STRIDE * process_index
+    # process 0 keeps unprefixed names (the single-process common case
+    # reads cleanly); workers carry their index so merged traces name
+    # every track's owner
+    prefix = f"p{process_index}: " if process_index else ""
+
+    def meta(track: int, name: str, tid: Optional[int] = None) -> dict:
         e = {
             "ph": "M",
-            "pid": pid,
+            "pid": pid_base + track,
             "name": "process_name" if tid is None else "thread_name",
-            "args": {"name": name},
+            "args": {"name": (name if tid is not None else prefix + name)},
         }
         if tid is not None:
             e["tid"] = tid
@@ -931,7 +987,7 @@ def write_chrome_trace(
                     "ph": "X",
                     "name": name,
                     "cat": "dispatch",
-                    "pid": 0,
+                    "pid": pid_base,
                     "tid": tid,
                     "ts": round((start - t0) * _US, 3),
                     "dur": round(dur * _US, 3),
@@ -945,7 +1001,7 @@ def write_chrome_trace(
                         "ph": "i",
                         "name": f"retrace:{r['kind']}",
                         "cat": "retrace",
-                        "pid": 0,
+                        "pid": pid_base,
                         "tid": tid,
                         "ts": round(max(r["t"], 0.0) * _US, 3),
                         "s": "t",
@@ -961,7 +1017,7 @@ def write_chrome_trace(
                         "ph": "X",
                         "name": span["name"],
                         "cat": "fetch",
-                        "pid": 0,
+                        "pid": pid_base,
                         "tid": tid,
                         "ts": round((span["t0"] - t0) * _US, 3),
                         "dur": round(span["dt"] * _US, 3),
@@ -988,13 +1044,13 @@ def write_chrome_trace(
                 span = max(hi - lo, 1)
                 scale = (window_s / span) if window_s > 0 else 1e-3
                 rel = [((g - lo) * scale, v) for g, v in samples]
-                events.extend(_counter_events(track, rel, pid=1))
+                events.extend(_counter_events(track, rel, pid=pid_base + 1))
 
     if extra_counters:
         events.append(meta(2, "host counters"))
         for track, samples in extra_counters.items():
             rel = [(t - t0, v) for t, v in samples]
-            events.extend(_counter_events(track, rel, pid=2))
+            events.extend(_counter_events(track, rel, pid=pid_base + 2))
 
     if supervisor is None and workflow is not None:
         supervisor = getattr(workflow, "_run_supervisor", None)
@@ -1008,7 +1064,7 @@ def write_chrome_trace(
                         "ph": "i",
                         "name": m["name"],
                         "cat": "supervisor",
-                        "pid": 3,
+                        "pid": pid_base + 3,
                         "tid": 1,
                         "ts": round(max(m["t_abs"] - t0, 0.0) * _US, 3),
                         "s": "p",
@@ -1032,7 +1088,7 @@ def write_chrome_trace(
                         "ph": "i",
                         "name": m["name"],
                         "cat": "supervisor",
-                        "pid": 5,
+                        "pid": pid_base + 5,
                         "tid": 1,
                         "ts": round(max(m["t_abs"] - t0, 0.0) * _US, 3),
                         "s": "p",
@@ -1061,7 +1117,7 @@ def write_chrome_trace(
                     "ph": "X",
                     "name": span["name"],
                     "cat": "executor",
-                    "pid": 4,
+                    "pid": pid_base + 4,
                     "tid": tids[span["track"]],
                     "ts": round(max(span["t_abs"] - t0, 0.0) * _US, 3),
                     "dur": round(max(span["dur"], 0.0) * _US, 3),
@@ -1071,7 +1127,7 @@ def write_chrome_trace(
                 events.append(ev)
             for track, track_samples in samples.items():
                 rel = [(t - t0, v) for t, v in track_samples]
-                events.extend(_counter_events(track, rel, pid=4))
+                events.extend(_counter_events(track, rel, pid=pid_base + 4))
 
     trace = {
         "traceEvents": events,

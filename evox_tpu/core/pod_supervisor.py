@@ -268,6 +268,7 @@ class PodSupervisor:
         epoch: int = 0,
         namespace: str = "evox_tpu/pod",
         clock: Callable[[], float] = time.perf_counter,
+        metrics: Any = None,
     ):
         if heartbeat_interval_s <= 0:
             raise ValueError(
@@ -289,6 +290,13 @@ class PodSupervisor:
         self.heartbeat_interval_s = float(heartbeat_interval_s)
         self.epoch = int(epoch)
         self.namespace = f"{namespace}/e{self.epoch}"
+        # serving-plane flight recorder (PR 16): when attached, every
+        # pod transition mirrors into the metrics plane (`pod.*`
+        # counters, heartbeat-publish latency histogram), pod barriers
+        # stamp stream `barrier` records (the merged-trace alignment
+        # anchors), and failures carry the black-box ring in their
+        # post-mortem. None (default) changes nothing.
+        self.metrics = metrics
         self._clock = clock
         self._created = clock()
         try:
@@ -348,6 +356,8 @@ class PodSupervisor:
             counter = _COUNTER_FOR.get(kind)
             if counter is not None:
                 self.counters[counter] += 1
+        if self.metrics is not None:
+            self.metrics.count(f"pod.{kind}")
 
     def _journal_event(self, kind: str, **payload: Any) -> None:
         """WAL the transition (process-0-writes). A journal append
@@ -405,11 +415,19 @@ class PodSupervisor:
         client = self._client()
         if client is not None:
             # overwrite-in-place: one key per member per epoch, no growth
+            t0 = self._clock()
             client.key_value_set(
                 f"{self.namespace}/hb/{self.process_id}",
                 str(self._hb_seq),
                 allow_overwrite=True,
             )
+            if self.metrics is not None:
+                # heartbeat PUBLISH latency: the KV round-trip each beat
+                # pays — the earliest coordination-plane distress signal
+                # (it climbs before collectives start timing out)
+                self.metrics.observe(
+                    "pod.heartbeat_ms", (self._clock() - t0) * 1e3
+                )
         return self._hb_seq
 
     #: consecutive failed beats before the heartbeat thread gives up —
@@ -539,6 +557,15 @@ class PodSupervisor:
             "process_count": self.process_count,
             "events_tail": self.events[-20:],
         }
+        if self.metrics is not None:
+            # every pod post-mortem carries the flight-recorder tail:
+            # the last queue/executor/pod records before the fault,
+            # recoverable from the surviving stream even if this
+            # process dies before the error is printed
+            self.metrics.event(
+                "pod.failure", entry=entry, classification=classification
+            )
+            post_mortem["flight_recorder"] = self.metrics.tail(20)
         self._journal_event(
             "pod_failure",
             entry=entry,
@@ -589,6 +616,16 @@ class PodSupervisor:
                 process_barrier(name)
             else:
                 process_barrier(name, timeout_s=tmo)
+            if self.metrics is not None:
+                # a REAL pod rendezvous just released: every member
+                # stamps the same barrier name into its own stream at
+                # (approximately) the same instant — the clock-alignment
+                # anchor merge_pod_streams aligns the per-process trace
+                # tracks on
+                self.metrics.barrier(
+                    f"pod:{name}",
+                    wait_ms=round((self._clock() - t0) * 1e3, 3),
+                )
         except (KeyboardInterrupt, SystemExit):
             raise
         except BarrierTimeoutError as e:

@@ -8,6 +8,12 @@ from .surrogate import SurrogateWorkflow, SurrogateWorkflowState
 from .islands import IslandWorkflow, IslandWorkflowState
 from .pipelined import run_host_pipelined
 from .journal import JournalIntegrityError, RunJournal
+from .flightrec import (
+    FlightRecorder,
+    MetricsStream,
+    merge_pod_streams,
+    read_stream,
+)
 from .fleet_health import FleetHealthPolicy, fleet_health_signals
 from .tenancy import (
     RunQueue,
@@ -62,6 +68,10 @@ __all__ = [
     "restore_layouts",
     "RunJournal",
     "JournalIntegrityError",
+    "FlightRecorder",
+    "MetricsStream",
+    "merge_pod_streams",
+    "read_stream",
     "FleetHealthPolicy",
     "fleet_health_signals",
     "run_host_pipelined",

@@ -620,6 +620,7 @@ class ElasticServer:
         autoscaler: Optional[PopAutoscaler] = None,
         supervisor: Any = None,
         strict_after_warm: bool = False,
+        metrics: Any = None,
     ):
         self.factory = factory
         self.table = table if table is not None else BucketTable()
@@ -635,6 +636,18 @@ class ElasticServer:
         self.autoscaler = autoscaler
         self.supervisor = supervisor
         self.strict_after_warm = strict_after_warm
+        # serving-plane flight recorder (PR 16): ONE recorder spans the
+        # whole lattice — threaded into every bucket RunQueue (whose
+        # samples then share one SLO ledger across buckets) and the
+        # shared executable cache. A str/Path builds a stream-backed
+        # recorder; None (default) changes nothing.
+        if isinstance(metrics, (str, Path)):
+            from .flightrec import FlightRecorder
+
+            metrics = FlightRecorder(directory=str(metrics))
+        self.metrics = metrics
+        if metrics is not None and getattr(self.cache, "metrics", None) is None:
+            self.cache.metrics = metrics
         self._buckets: Dict[str, _Bucket] = {}
         self._filler_seq = 0
         self.autoscale_events: List[dict] = []
@@ -690,6 +703,7 @@ class ElasticServer:
                 if self.checkpoint_dir is not None
                 else None
             ),
+            metrics=self.metrics,
         )
         b = _Bucket(shape=shape, workflow=wf, queue=q)
         self._buckets[shape.key] = b
@@ -963,6 +977,14 @@ class ElasticServer:
         #    already durable on the target side
         q.counters["grown"] = q.counters.get("grown", 0) + 1
         entry = q._close_out(index, status="grown")
+        if self.metrics is not None:
+            self.metrics.count("elastic.grows")
+            self.metrics.event(
+                "elastic.grow",
+                tag=spec.tag,
+                from_bucket=b.shape.key,
+                to_bucket=tb.shape.key,
+            )
         self.autoscale_events.append(
             {
                 "tag": spec.tag,

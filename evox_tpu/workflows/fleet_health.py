@@ -154,6 +154,13 @@ class FleetHealthPolicy:
     stagnation_limit: Optional[int] = None
     on_stagnation: Optional[str] = "restart"
     max_restarts_per_slot: int = 2
+    # serving-plane flight recorder (PR 16): when attached (RunQueue
+    # auto-threads its recorder), every verdict counts into the metrics
+    # plane by reason (`fleet_health.<reason-class>`); excluded from
+    # comparison/repr — the policy's identity is its thresholds
+    metrics: Any = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
     def __post_init__(self) -> None:
         for name in ("on_nonfinite", "on_trigger", "on_stagnation"):
@@ -188,23 +195,33 @@ class FleetHealthPolicy:
         :func:`fleet_health_signals` (python scalars); ``slot_restarts``:
         in-place restarts this slot has already had (queue-tracked)."""
         if self.on_nonfinite is not None and bool(row.get("nonfinite")):
-            return (
+            return self._verdict(
                 self._resolve(self.on_nonfinite, slot_restarts),
                 "nonfinite_state",
             )
         if self.on_trigger is not None and int(row.get("guard_trigger", 0)):
-            return (
+            return self._verdict(
                 self._resolve(self.on_trigger, slot_restarts),
                 f"guard_trigger:{int(row['guard_trigger'])}",
             )
         if self.stagnation_limit is not None and self.on_stagnation is not None:
             stag = row.get("stagnation", row.get("guard_stagnation"))
             if stag is not None and int(stag) >= self.stagnation_limit:
-                return (
+                return self._verdict(
                     self._resolve(self.on_stagnation, slot_restarts),
                     f"stagnation:{int(stag)}",
                 )
         return None
+
+    def _verdict(self, action: str, reason: str) -> Tuple[str, str]:
+        if self.metrics is not None:
+            # reason class only (strip the per-tenant numeric suffix):
+            # metric names must be low-cardinality for the stream's
+            # monotonic-counter law to stay meaningful
+            self.metrics.count(
+                f"fleet_health.{action}.{reason.split(':', 1)[0]}"
+            )
+        return (action, reason)
 
     def report(self) -> dict:
         """Static policy config for ``run_report``'s ``fleet_health``."""

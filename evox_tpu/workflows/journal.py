@@ -48,7 +48,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["RunJournal", "JournalIntegrityError"]
+__all__ = ["ChainedLog", "RunJournal", "JournalIntegrityError"]
 
 _SCHEMA = "evox_tpu.run_journal/v1"
 _GENESIS = "0" * 64
@@ -125,22 +125,32 @@ def _canonical(record: Dict[str, Any]) -> bytes:
     ).encode()
 
 
-class RunJournal:
-    """Append-only, fsynced, hash-chained JSON-lines event log.
+class ChainedLog:
+    """Append-only, fsynced, hash-chained JSON-lines event log — the
+    reusable half of :class:`RunJournal` (PR 16 refactor: the metrics
+    stream in ``workflows/flightrec.py`` shares the identical adoption,
+    torn-tail-repair, and tamper-evidence machinery, so the discipline
+    lives once). Subclasses pin three class attributes:
+
+    - ``FILENAME``: the JSON-lines file inside the directory,
+    - ``SCHEMA``: the per-record ``schema`` tag,
+    - ``KINDS``: the closed event-kind whitelist (``None`` = any kind).
 
     Args:
-        directory: journal directory (created if missing). An existing
-            ``journal.jsonl`` is ADOPTED: the chain is verified, a torn
-            tail is truncated with a warning, and appends continue the
-            chain — that is the crash-recovery path.
+        directory: log directory (created if missing). An existing file
+            is ADOPTED: the chain is verified, a torn tail is truncated
+            with a warning, and appends continue the chain — that is
+            the crash-recovery path.
 
-    Thread safety: ``append`` takes an internal lock, so the queue's
-    caller thread and the executor's background lanes may interleave
-    appends; each record is written and fsynced atomically under the
-    lock, so the chain stays valid in submission order.
+    Thread safety: ``append`` takes an internal lock, so the caller
+    thread and the executor's background lanes may interleave appends;
+    each record is written and fsynced atomically under the lock, so
+    the chain stays valid in submission order.
     """
 
-    FILENAME = "journal.jsonl"
+    FILENAME = "chain.jsonl"
+    SCHEMA = _SCHEMA
+    KINDS: Optional[tuple] = None
 
     def __init__(self, directory: str):
         self.directory = Path(directory)
@@ -239,27 +249,27 @@ class RunJournal:
             out[r["kind"]] = out.get(r["kind"], 0) + 1
         return out
 
-    @staticmethod
-    def verify(directory: str) -> int:
-        """Re-read a journal from disk, raising
+    @classmethod
+    def verify(cls, directory: str) -> int:
+        """Re-read a log from disk, raising
         :class:`JournalIntegrityError` on a broken chain; returns the
         number of intact records. (Adoption already verifies — this is
         the standalone audit entry point.)"""
-        return len(RunJournal(directory).records())
+        return len(cls(directory).records())
 
     # ----------------------------------------------------------------- write
     def append(self, kind: str, **payload: Any) -> Dict[str, Any]:
         """Append one event record and fsync it before returning — the
         WAL guarantee: once ``append`` returns, the transition is
         durable. ``payload`` values are coerced to strict JSON."""
-        if kind not in EVENT_KINDS:
+        if self.KINDS is not None and kind not in self.KINDS:
             raise ValueError(
-                f"unknown journal event kind {kind!r}; expected one of "
-                f"{EVENT_KINDS}"
+                f"unknown {type(self).__name__} event kind {kind!r}; "
+                f"expected one of {self.KINDS}"
             )
         with self._lock:
             record: Dict[str, Any] = {
-                "schema": _SCHEMA,
+                "schema": self.SCHEMA,
                 "seq": len(self._records),
                 "kind": kind,
                 "t": round(time.time(), 6),
@@ -278,6 +288,18 @@ class RunJournal:
             self._records.append(record)
             self._last_sha = record["sha"]
             return record
+
+class RunJournal(ChainedLog):
+    """The serving queue's durable WAL (module docstring): the
+    :class:`ChainedLog` machinery under the ``journal.jsonl`` name with
+    the queue-transition kind whitelist — ``append()`` rejects anything
+    outside :data:`EVENT_KINDS` so a typo'd kind cannot silently create
+    an event class the recovery replay and the run_report validator do
+    not know about."""
+
+    FILENAME = "journal.jsonl"
+    SCHEMA = _SCHEMA
+    KINDS = EVENT_KINDS
 
     # ---------------------------------------------------------------- report
     def report(self) -> dict:

@@ -247,6 +247,7 @@ class RunSupervisor:
         min_eval_chunk: int = 1,
         seed: int = 0,
         clock: Callable[[], float] = time.perf_counter,
+        metrics: Any = None,
     ):
         if max_retries < 0 or max_restores < 0:
             raise ValueError("max_retries and max_restores must be >= 0")
@@ -261,6 +262,11 @@ class RunSupervisor:
         self.jitter = jitter
         self.min_eval_chunk = min_eval_chunk
         self._rng = random.Random(seed)
+        # serving-plane flight recorder (PR 16): when attached, every
+        # ladder event mirrors into the live metrics plane and aborts
+        # carry the recorder's black-box ring in their post-mortem;
+        # None (default) changes nothing
+        self.metrics = metrics
         self._clock = clock
         self._created = clock()
         self.events: List[dict] = []
@@ -282,6 +288,8 @@ class RunSupervisor:
         counter = _COUNTER_FOR.get(kind)
         if counter is not None:
             self.counters[counter] += 1
+        if self.metrics is not None:
+            self.metrics.count(f"supervisor.{kind}")
 
     def report(self) -> dict:
         """The ``supervisor`` section of ``run_report()`` — strict-JSON
@@ -335,6 +343,15 @@ class RunSupervisor:
             "counters": dict(self.counters),
             "events_tail": self.events[-20:],
         }
+        if self.metrics is not None:
+            # the black-box tape: the newest flight-recorder records
+            # (queue events, samples, barriers) ride in every abort's
+            # forensics, so "what was the service doing" survives the
+            # process that was doing it
+            self.metrics.event(
+                "supervisor.abort", entry=entry, error=str(error)[:120]
+            )
+            post_mortem["flight_recorder"] = self.metrics.tail(20)
         raise RunAbortedError(
             f"supervised '{entry}' exhausted its escalation ladder "
             f"({ladder}); last failure: {type(error).__name__}: {error}",

@@ -1021,6 +1021,7 @@ class RunQueue:
         executor: Any = None,
         journal: Any = None,
         health_policy: Any = None,
+        metrics: Any = None,
     ):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -1073,6 +1074,32 @@ class RunQueue:
             else None
         )
         self.health_policy = health_policy
+        # serving-plane flight recorder (PR 16): `metrics=None` is an
+        # exact no-op — every producer call site below is gated, reads
+        # only already-fetched host values, and writes only host memory/
+        # files, so states stay bit-identical and no stream file exists.
+        # A str/Path builds a stream-backed recorder in that directory.
+        if isinstance(metrics, (str, Path)):
+            from .flightrec import FlightRecorder
+
+            metrics = FlightRecorder(directory=str(metrics))
+        self.metrics = metrics
+        if metrics is not None:
+            # one recorder serves the whole serving stack: the executor
+            # mirrors its dispatch telemetry, the exec cache its
+            # hit/miss/compile-ms, the health policy its verdicts; the
+            # workflow backref is run_report's `metrics`/`slo` pickup
+            workflow._flight_recorder = metrics
+            if getattr(self.executor, "metrics", None) is None:
+                self.executor.metrics = metrics
+            cache = getattr(workflow, "_exec_cache", None)
+            if cache is not None and getattr(cache, "metrics", None) is None:
+                cache.metrics = metrics
+            if (
+                health_policy is not None
+                and getattr(health_policy, "metrics", None) is None
+            ):
+                health_policy.metrics = metrics
         self.health_events: List[dict] = []
         self._slot_restarts: List[int] = [0] * workflow.n_tenants
         self._config_sha: Optional[str] = None
@@ -1311,6 +1338,12 @@ class RunQueue:
         self.slots = [_Slot(spec=s) for s in specs]
         fresh = [i for i, (k, _) in enumerate(units) if k == "spec"]
         self.counters["admitted"] += len(fresh)
+        if self.metrics is not None and fresh:
+            # start()'s batch seating bypasses _install for fresh specs
+            # (one vmapped init instead of N surgeries) — mirror it, or
+            # the SLO ledger under-counts exactly the first fleet-full
+            # of admissions and the coherence validator flags every run
+            self.metrics.count("slo.admissions", len(fresh))
         if self.journal is not None:
             for i in fresh:
                 self.journal.append(
@@ -1344,10 +1377,18 @@ class RunQueue:
 
     def _dispatch(self, n: int) -> None:
         wf = self.workflow
+        running = sum(1 for s in self.slots if s is not None and s.active)
         self.state = self.executor.run_fused(
             wf, self.state, n, supervisor=self.supervisor
         )
         self.counters["chunks"] += 1
+        if self.metrics is not None:
+            # tenant-generations actually SERVED this chunk: n fused
+            # generations × tenants doing real work (parked/frozen rows
+            # step in lockstep but serve nobody) — the SLO ledger's
+            # numerator, accumulated at the dispatch boundary
+            self.metrics.count("slo.tenant_gens", n * running)
+            self.metrics.count("queue.chunks")
 
     def _tenant_generations(self):
         """Per-slot OWN generation counters, read from the state (one
@@ -1428,6 +1469,22 @@ class RunQueue:
         self._sweep()
         self._apply_health_policy()
         self._barrier()
+        if self.metrics is not None:
+            # the per-chunk sample: queue-depth gauges plus one durable
+            # full-registry snapshot whose embedded `queue` counters are
+            # the validator's coherence referee (check_report re-checks
+            # slo.* against them on every sample record)
+            m = self.metrics
+            m.set("queue.pending", len(self.pending))
+            m.set("queue.continuations", len(self.continuations))
+            m.set(
+                "queue.running",
+                sum(1 for s in self.slots if s is not None and s.active),
+            )
+            m.sample(
+                queue=dict(self.counters),
+                generation=int(self.state.generation),
+            )
         more = (
             any(s is not None and s.active for s in self.slots)
             or bool(self.pending)
@@ -1533,6 +1590,8 @@ class RunQueue:
             if self.journal is not None:
                 self.journal.append("health", **event)
             self.health_events.append(event)
+            if self.metrics is not None:
+                self.metrics.count(f"health.{action}")
             if action == "freeze":
                 self._freeze(i)
             elif action == "evict":
@@ -1634,6 +1693,28 @@ class RunQueue:
                 index, state=self.state
             ).items()
         }
+        if self.metrics is not None:
+            fleet_gen = int(self.state.generation)
+            deadline = slot.spec.deadline
+            if deadline is not None and status in (
+                "completed", "evicted", "frozen",
+            ):
+                # the SLO ledger's verdict column: a deadlined spec is
+                # settled ONLY at a terminal close-out (preemption and
+                # growth park continuations — the contract still stands)
+                if status == "completed" and fleet_gen <= int(deadline):
+                    self.metrics.count("slo.deadline_hits")
+                else:
+                    self.metrics.count("slo.deadline_misses")
+            self.metrics.event(
+                f"queue.{status}",
+                tag=slot.spec.tag,
+                slot=index,
+                generations=entry["generations"],
+            )
+            if status in ("evicted", "frozen"):
+                # every queue post-mortem carries the black-box tape
+                entry["flight_recorder"] = self.metrics.tail(20)
         if self.journal is not None:
             kind = {
                 "evicted": "evict",
@@ -1804,6 +1885,13 @@ class RunQueue:
         self.counters["admitted"] += 1
         if resumed:
             self.counters["readmitted"] += 1
+        if self.metrics is not None:
+            # EDF admissions land here too (the SLA pass installs its
+            # urgent spec through _install) — one site keeps the SLO
+            # ledger coherent with counters["admitted"] by construction
+            self.metrics.count("slo.admissions")
+            if resumed:
+                self.metrics.count("queue.readmissions")
         if self.journal is not None:
             self.journal.append(
                 "admit",
@@ -1923,6 +2011,10 @@ class RunQueue:
     def _preempt(self, index: int) -> None:
         slot = self.slots[index]
         self.counters["preempted"] += 1
+        if self.metrics is not None:
+            # the discrete event itself rides the _close_out status
+            # record (`queue.preempted`); only the ledger counter here
+            self.metrics.count("slo.preemptions")
         entry = self._close_out(index, status="preempted", refill=False)
         ckpt_dir = entry.get("checkpoint")
         if ckpt_dir is None:
@@ -1949,6 +2041,7 @@ class RunQueue:
         executor: Any = None,
         health_policy: Any = None,
         allow_config_mismatch: bool = False,
+        metrics: Any = None,
     ) -> "RunQueue":
         """Rebuild a journaled sweep after the driver died — at ANY
         point, including mid-background-fsync.
@@ -2013,6 +2106,7 @@ class RunQueue:
             executor=executor,
             journal=journal,
             health_policy=health_policy,
+            metrics=metrics,
         )
         q._spec_seq = max(specs, default=-1) + 1
         q.counters["submitted"] = len(specs)
@@ -2057,6 +2151,8 @@ class RunQueue:
             # fresh, each spec still executed exactly once overall
             _requeue_all()
             journal.append("recover", generation=None, snapshot=None)
+            if q.metrics is not None:
+                q.metrics.restore_at(generation=None)
             return q
         # --- config guard (PR 5 fingerprint, reused): the supplied
         # workflow must produce the SAME fleet state structure the
@@ -2114,6 +2210,8 @@ class RunQueue:
             # or mid-first-fsync): re-queue everything and start fresh
             _requeue_all()
             journal.append("recover", generation=None, snapshot=None)
+            if q.metrics is not None:
+                q.metrics.restore_at(generation=None)
             return q
         state = workflow.place_restored(state)
         if (
@@ -2258,6 +2356,13 @@ class RunQueue:
             generation=int(meta["generation"]),
             snapshot=meta.get("snapshot"),
         )
+        if q.metrics is not None:
+            # restore the metrics plane to the SAME barrier the fleet
+            # came back to: the replayed stretch re-counts exactly what
+            # the crash rolled back, so the post-crash SLO ledger
+            # converges to the uncrashed run's (the validator resets its
+            # monotonicity baseline at the queue.recover event)
+            q.metrics.restore_at(generation=int(meta["generation"]))
         return q
 
     # -------------------------------------------------------------- report

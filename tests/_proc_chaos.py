@@ -64,7 +64,7 @@ def build_workflow():
     )
 
 
-def build_queue(journal_dir, workflow=None, health_policy=None):
+def build_queue(journal_dir, workflow=None, health_policy=None, metrics_dir=None):
     from evox_tpu import RunQueue
 
     return RunQueue(
@@ -72,6 +72,7 @@ def build_queue(journal_dir, workflow=None, health_policy=None):
         chunk=CHUNK,
         journal=str(journal_dir),
         health_policy=health_policy,
+        metrics=None if metrics_dir is None else str(metrics_dir),
     )
 
 
@@ -127,6 +128,7 @@ def driver_main(
     journal_dir: str,
     kill_after_chunks: Optional[int] = None,
     kill_fsync: Optional[Tuple[str, int]] = None,
+    metrics_dir: Optional[str] = None,
 ) -> None:
     """Child entry point: run the canonical sweep, die on schedule.
     Exits 0 on clean completion with no kill configured, 7 when a
@@ -135,7 +137,7 @@ def driver_main(
     os.environ["JAX_PLATFORMS"] = "cpu"
     if kill_fsync is not None:
         _install_fsync_kill(*kill_fsync)
-    q = build_queue(journal_dir)
+    q = build_queue(journal_dir, metrics_dir=metrics_dir)
     submit_all(q)
     q.start()
     while True:
@@ -148,6 +150,27 @@ def driver_main(
         if not more:
             break
     sys.exit(0 if kill_after_chunks is None and kill_fsync is None else 7)
+
+
+# ------------------------------------------------------ metrics appender
+# PR 16: the SIGKILL-mid-metrics-append law needs a child that is doing
+# nothing BUT appending to the metrics stream when it dies, so the kill
+# lands mid-fsync-cycle with probability ~1 instead of mostly hitting
+# compute. No jax work: FlightRecorder is pure host-side file I/O.
+
+
+def metrics_child_main(stream_dir: str) -> None:
+    """Child entry point: append count/event/sample records in a tight
+    loop until SIGKILL'd by the parent."""
+    from evox_tpu.workflows.flightrec import FlightRecorder
+
+    fr = FlightRecorder(directory=stream_dir)
+    g = 0
+    while True:
+        g += 1
+        fr.count("slo.tenant_gens", 3)
+        fr.event("queue.tick", g=g)
+        fr.sample(generation=g)
 
 
 # ----------------------------------------------------------- SLA variant
@@ -261,13 +284,19 @@ def run_driver(
     kill_after_chunks: Optional[int] = None,
     kill_fsync: Optional[Tuple[str, int]] = None,
     timeout: float = 600.0,
+    metrics_dir=None,
 ) -> int:
     """Spawn the driver child; returns its exit code (-SIGKILL when the
     scripted kill fired)."""
     ctx = mp.get_context("spawn")
     p = ctx.Process(
         target=driver_main,
-        args=(str(journal_dir), kill_after_chunks, kill_fsync),
+        args=(
+            str(journal_dir),
+            kill_after_chunks,
+            kill_fsync,
+            None if metrics_dir is None else str(metrics_dir),
+        ),
         daemon=True,
     )
     p.start()

@@ -685,3 +685,181 @@ def test_validator_v10_surrogate_bench_rules():
     del bad["surrogate"]["run_report"]
     errors = "\n".join(check_report.validate_bench(bad))
     assert "machine-validated" in errors
+
+
+# ------------------------------------------------ v11 metrics plane (PR 16)
+
+
+def test_validator_v11_schema_version_rules():
+    """v11 reports must carry a schema_version int that agrees with the
+    schema tag suffix; v10-and-earlier reports stay exempt."""
+    report = _fresh_report(False)
+    assert report["schema"] == "evox_tpu.run_report/v11"
+    assert report["schema_version"] == 11
+    bad = json.loads(json.dumps(report))
+    del bad["schema_version"]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "schema_version" in errors
+    bad = json.loads(json.dumps(report))
+    bad["schema_version"] = 10
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "disagrees" in errors
+    # pre-v11 shapes carry no schema_version and are not asked for one
+    old = {"schema": "evox_tpu.run_report/v10"}
+    assert not any(
+        "schema_version" in e for e in check_report.validate_run_report(old)
+    )
+
+
+def _metrics_report():
+    """A minimal v11 report with live metrics + slo sections, built from
+    a real FlightRecorder (the shape run_report(metrics=...) emits)."""
+    from evox_tpu import FlightRecorder
+
+    fr = FlightRecorder()
+    fr.count("slo.tenant_gens", 40)
+    fr.count("slo.admissions", 4)
+    fr.set("queue.pending", 2)
+    fr.observe("dispatch.ms", 12.0)
+    return run_report(metrics=fr)
+
+
+def test_validator_v11_metrics_and_slo_rules():
+    report = _metrics_report()
+    assert check_report.validate_run_report(report) == []
+
+    bad = json.loads(json.dumps(report))
+    bad["metrics"]["enabled"] = False
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "metrics.enabled" in errors
+
+    bad = json.loads(json.dumps(report))
+    bad["metrics"]["counters"]["slo.tenant_gens"] = -1
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "slo.tenant_gens" in errors
+
+    bad = json.loads(json.dumps(report))
+    bad["metrics"]["histograms"]["dispatch.ms"]["counts"] = [99]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "histograms.dispatch.ms" in errors
+
+    # the slo ledger and the registry counters come from one registry:
+    # a disagreement is corruption, not rounding
+    bad = json.loads(json.dumps(report))
+    bad["slo"]["admissions"] = 9
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "disagree" in errors or "admissions" in errors
+
+    bad = json.loads(json.dumps(report))
+    bad["slo"]["tenant_gens_per_s"] = 1e9
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "incoherent" in errors
+
+
+def _stream_rec(kind, **fields):
+    return {
+        "schema": "evox_tpu.metrics_stream/v1",
+        "kind": kind,
+        "tm": fields.pop("tm", 0.5),
+        **fields,
+    }
+
+
+def _stream_sample(gens, tm=0.5, **extra):
+    slo = {
+        "tenant_gens": gens,
+        "elapsed_s": 10.0,
+        "tenant_gens_per_s": gens / 10.0,
+        "admissions": extra.pop("admissions", 0),
+        "preemptions": 0,
+        "deadline_hits": 0,
+        "deadline_misses": 0,
+    }
+    counters = {
+        "slo.tenant_gens": gens,
+        "slo.admissions": slo["admissions"],
+    }
+    return _stream_rec(
+        "sample", tm=tm, counters=counters, slo=slo, **extra
+    )
+
+
+def _stream_meta():
+    rec = _stream_rec("meta", process_id=0, process_count=1, pid_base=0)
+    del rec["tm"]
+    return rec
+
+
+def test_validator_metrics_stream_rules():
+    good = [_stream_meta(), _stream_sample(12), _stream_sample(24, tm=1.0)]
+    assert check_report.validate_metrics_stream(good) == []
+
+    # counters are monotone across samples...
+    dec = [_stream_meta(), _stream_sample(24), _stream_sample(12, tm=1.0)]
+    errors = "\n".join(check_report.validate_metrics_stream(dec))
+    assert "decreased" in errors
+
+    # ...except across a queue.recover baseline reset (crash replay)
+    healed = [
+        _stream_meta(),
+        _stream_sample(24),
+        _stream_rec("event", name="queue.recover", tm=0.9),
+        _stream_sample(12, tm=1.0),
+    ]
+    assert check_report.validate_metrics_stream(healed) == []
+
+    # the ledger must agree with the registry snapshot it rode in on
+    lying = [_stream_meta(), _stream_sample(12)]
+    lying[1]["slo"]["tenant_gens"] = 99
+    errors = "\n".join(check_report.validate_metrics_stream(lying))
+    assert "disagrees" in errors
+
+    # ...and dominate any queue context it carries
+    starved = [
+        _stream_meta(),
+        _stream_sample(12, admissions=1, queue={"admitted": 3}),
+    ]
+    errors = "\n".join(check_report.validate_metrics_stream(starved))
+    assert "queue.admitted" in errors
+
+    unknown = [_stream_meta(), _stream_rec("vibe", name="x")]
+    errors = "\n".join(check_report.validate_metrics_stream(unknown))
+    assert "kind" in errors
+
+    anonymous = [_stream_sample(12)]
+    errors = "\n".join(check_report.validate_metrics_stream(anonymous))
+    assert "identity" in errors
+
+
+def test_validate_file_sniffs_metrics_stream(tmp_path):
+    """validate_file dispatches a metrics .jsonl to the stream
+    validator and tolerates ONLY a torn FINAL line — the one artifact a
+    crash mid-append can leave."""
+    from evox_tpu import FlightRecorder
+
+    fr = FlightRecorder(directory=str(tmp_path))
+    for g in (2, 4):
+        fr.count("slo.tenant_gens", 8)
+        fr.sample(generation=g)
+    path = fr.stream.path
+    assert check_report.validate_file(str(path)) == []
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "sample", "tm"')  # the crash artifact
+    assert check_report.validate_file(str(path)) == []
+    with open(path, "ab") as f:
+        f.write(b'\n{"kind": "event"}\n')  # torn line NOT final: corrupt
+    assert check_report.validate_file(str(path)) != []
+
+
+def test_schema_flag_lists_and_detects(tmp_path, capsys):
+    assert check_report.main(["--schema"]) == 0
+    out = capsys.readouterr().out
+    assert "evox_tpu.run_report/v11" in out
+    assert "evox_tpu.metrics_stream/v1" in out
+    from evox_tpu import FlightRecorder
+
+    fr = FlightRecorder(directory=str(tmp_path))
+    fr.sample(generation=1)
+    assert check_report.main(["--schema", str(fr.stream.path)]) == 0
+    out = capsys.readouterr().out
+    assert "evox_tpu.metrics_stream/v1" in out

@@ -790,6 +790,7 @@ def test_fleet_fingerprint_transform_identity():
     ) != _transform_identity(partial(jnp.add, big2))
 
 
+@pytest.mark.slow
 def test_autoscaler_growth_peels_init_overrides():
     """Review finding: a grown tenant of an init_ask/init_tell algorithm
     (CSO keeps parent fitness from its first generation) must get the

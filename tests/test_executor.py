@@ -247,6 +247,7 @@ def test_fused_crash_resume_through_executor(tmp_path):
 
 
 # ------------------------------------------- supervisor laws through executor
+@pytest.mark.slow
 def test_supervisor_retry_heals_bit_identical_through_executor(tmp_path):
     key = jax.random.PRNGKey(7)
     wf_clean = _pso_wf(_DeviceSphere())
@@ -529,7 +530,8 @@ def test_executor_section_and_trace_validate(tmp_path):
     s = wf.init(jax.random.PRNGKey(4))
     s = ex.run_host(wf, s, 6)
     rep = run_report(wf, s, recorder=rec)
-    assert rep["schema"].endswith("/v10")
+    assert rep["schema"].endswith("/v11")
+    assert rep["schema_version"] == 11
     assert rep["executor"]["counters"]["tells"] == 6
     assert rep["executor"]["overlap"]["wall_s"] > 0
     assert check_report.validate_run_report(rep) == []

@@ -1,0 +1,381 @@
+"""Serving-plane flight recorder units (PR 16): the metrics registry,
+the durable stream, the crash ring, pod-stream merging, and the
+post-mortem tape contract.
+
+Laws under test:
+
+- **Registry**: counters refuse to decrease, histograms refuse to
+  change buckets, one name means one kind; ``snapshot()`` is strict
+  JSON; the OpenMetrics exposition is byte-identical whether rendered
+  from the live registry or rebuilt by ``tools/evoxtail.py`` from a
+  stream sample (so scraping an rsync'd stream needs no package).
+- **Stream**: ``metrics.jsonl`` inherits the full ChainedLog
+  discipline — torn tail repaired with a warning on adoption, tampered
+  middle raises :class:`JournalIntegrityError` loudly (the
+  SIGKILL-mid-append law proper lives in test_serving_chaos.py, where
+  the kill is a real process death).
+- **Ring**: bounded, newest-wins; ``directory=None`` keeps everything
+  in memory and writes ZERO files.
+- **Recovery**: ``restore_at(generation)`` re-seeds the registry from
+  the matching stream sample and stamps the ``queue.recover`` event the
+  validator resets its monotonicity baseline on; ``restore_at(None)``
+  leaves the registry zeroed (the from-scratch replay seed).
+- **Pod merge**: two per-process streams sharing a barrier name align
+  on it, produce named per-process Perfetto tracks on disjoint
+  PID_STRIDE ranges, and both merge artifacts pass
+  ``tools/check_report.py validate_file``.
+- **Black box**: every post-mortem carries the ring tail —
+  ``RunSupervisor._abort`` and ``PodSupervisor._fail`` here, the
+  RunQueue evict close-out in test_serving_chaos.py.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from evox_tpu import (
+    FlightRecorder,
+    JournalIntegrityError,
+    MetricsStream,
+    PodFailureError,
+    PodSupervisor,
+    RunAbortedError,
+    RunSupervisor,
+    StdWorkflow,
+    merge_pod_streams,
+)
+from evox_tpu.core.metrics import DEFAULT_MS_BUCKETS, MetricsRegistry
+from evox_tpu.monitors import TelemetryMonitor
+from evox_tpu.workflows.flightrec import PID_STRIDE, read_stream
+
+try:
+    import sys
+
+    sys.path.insert(0, "tools")
+    import check_report
+    import evoxtail
+finally:
+    pass
+
+DIM, POP = 4, 8
+
+
+def _mk_wf():
+    from evox_tpu.algorithms.so.es import CMAES
+    from evox_tpu.problems.numerical import Sphere
+
+    algo = CMAES(center_init=jnp.ones(DIM), init_stdev=1.0, pop_size=POP)
+    return StdWorkflow(algo, Sphere(), monitors=(TelemetryMonitor(capacity=8),))
+
+
+# ----------------------------------------------------------------- registry
+
+
+def test_registry_kind_and_monotonicity_laws():
+    reg = MetricsRegistry()
+    reg.count("q.chunks", 3)
+    reg.count("q.chunks")
+    assert reg.value("q.chunks") == 4
+    with pytest.raises(ValueError, match="cannot decrease"):
+        reg.count("q.chunks", -1)
+    reg.set("q.depth", 7)
+    reg.set("q.depth", 2)  # gauges are last-write-wins levels
+    assert reg.value("q.depth") == 2
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.set("q.chunks", 1)
+    reg.observe("lat.ms", 3.0)
+    reg.observe("lat.ms", 80.0)
+    with pytest.raises(ValueError, match="fixed buckets"):
+        reg.histogram("lat.ms", (1.0, 2.0))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        reg.histogram("bad.ms", (5.0, 5.0))
+    with pytest.raises(ValueError, match="non-finite"):
+        reg.set("q.bad", float("nan"))
+    snap = reg.snapshot()
+    assert snap["counters"] == {"q.chunks": 4}
+    # q.bad was get-or-created before the finite check raised; it stays
+    # registered at zero — the set itself never landed
+    assert snap["gauges"] == {"q.depth": 2, "q.bad": 0}
+    h = snap["histograms"]["lat.ms"]
+    assert h["le"] == list(DEFAULT_MS_BUCKETS)
+    assert h["count"] == 2 and h["sum"] == 83.0
+    # cumulative Prometheus semantics: 3.0 lands in every bucket >= 5ms
+    assert h["counts"][0] == 0 and h["counts"][1] == 1
+    json.dumps(snap, allow_nan=False)  # strict-JSON by construction
+
+
+def test_openmetrics_parity_registry_vs_evoxtail():
+    """One serializer, two homes: the live registry's exposition and
+    evoxtail's stream-sample rebuild must be byte-identical — the
+    scrape contract for rsync'd streams."""
+    fr = FlightRecorder()
+    fr.count("slo.tenant_gens", 120)
+    fr.set("queue.pending", 5)
+    fr.observe("dispatch.ms", 12.5)
+    fr.observe("dispatch.ms", 0.4)
+    sample = fr.sample(generation=3)
+    assert evoxtail.to_openmetrics(sample) == fr.to_openmetrics()
+    text = fr.to_openmetrics()
+    assert "slo_tenant_gens_total 120" in text
+    assert text.endswith("# EOF\n")
+
+
+# ------------------------------------------------------------------- stream
+
+
+def test_metrics_stream_torn_tail_repaired(tmp_path):
+    fr = FlightRecorder(directory=str(tmp_path))
+    for g in range(3):
+        fr.count("slo.tenant_gens", 4)
+        fr.sample(generation=g)
+    raw = fr.stream.path.read_bytes()
+    fr.stream.path.write_bytes(raw[:-15])  # the crash artifact shape
+    with pytest.warns(UserWarning, match="torn tail"):
+        s2 = MetricsStream(str(tmp_path))
+    assert s2.torn_tail_dropped == 1
+    assert len(s2.records(kind="sample")) == 2
+    # physically repaired → the chain stays appendable, and a fresh
+    # recorder adopting the same directory does NOT duplicate the meta
+    fr2 = FlightRecorder(directory=str(tmp_path))
+    fr2.event("svc.resumed")
+    assert len(fr2.stream.records(kind="meta")) == 1
+    rep = fr2.stream.report()
+    assert rep["events"]["event"] == 1 and rep["torn_tail_dropped"] == 0
+
+
+def test_metrics_stream_tampered_middle_raises(tmp_path):
+    fr = FlightRecorder(directory=str(tmp_path))
+    for g in range(3):
+        fr.count("slo.tenant_gens", 4)
+        fr.sample(generation=g)
+    path = fr.stream.path
+    lines = path.read_text().splitlines()
+    middle = json.loads(lines[2])
+    middle["counters"]["slo.tenant_gens"] = 999  # rewrite history
+    lines[2] = json.dumps(middle, sort_keys=True, separators=(",", ":"))
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(JournalIntegrityError):
+        MetricsStream(str(tmp_path))
+
+
+def test_in_memory_recorder_writes_zero_files(tmp_path):
+    fr = FlightRecorder()  # directory=None: ring + registry only
+    fr.count("slo.tenant_gens", 8)
+    fr.event("queue.preempt", tag="t0")
+    fr.sample(generation=1)
+    assert fr.stream is None
+    assert not list(tmp_path.iterdir())
+    rep = fr.report()
+    assert rep["enabled"] is True and "stream" not in rep
+    assert rep["counters"]["slo.tenant_gens"] == 8
+    assert [r["kind"] for r in fr.tail()] == ["event", "sample"]
+
+
+def test_ring_is_bounded_newest_wins():
+    fr = FlightRecorder(ring_capacity=4)
+    for i in range(10):
+        fr.event("svc.tick", i=i)
+    tail = fr.tail()
+    assert len(tail) == 4
+    assert [r["i"] for r in tail] == [6, 7, 8, 9]
+    assert [r["i"] for r in fr.tail(2)] == [8, 9]
+    with pytest.raises(ValueError, match="ring_capacity"):
+        FlightRecorder(ring_capacity=0)
+
+
+def test_slo_ledger_derives_rate_and_counts():
+    fr = FlightRecorder()
+    fr.count("slo.tenant_gens", 30)
+    fr.count("slo.admissions", 3)
+    fr.count("slo.deadline_hits")
+    led = fr.slo_ledger()
+    assert led["tenant_gens"] == 30 and led["admissions"] == 3
+    assert led["deadline_hits"] == 1 and led["deadline_misses"] == 0
+    assert led["tenant_gens_per_s"] == pytest.approx(
+        30 / led["elapsed_s"], rel=1e-3
+    )
+
+
+# ----------------------------------------------------------------- recovery
+
+
+def test_restore_at_reseeds_registry_from_matching_sample(tmp_path):
+    fr = FlightRecorder(directory=str(tmp_path))
+    for g in (3, 6):
+        fr.count("slo.tenant_gens", 12)
+        fr.set("queue.pending", 9 - g)
+        fr.observe("dispatch.ms", float(g))
+        fr.sample(generation=g)
+    # a recovered driver adopts the stream and restores to the SAME
+    # barrier the fleet recovered to
+    fr2 = FlightRecorder(directory=str(tmp_path))
+    assert fr2.restore_at(generation=3) is True
+    assert fr2.registry.value("slo.tenant_gens") == 12
+    assert fr2.registry.value("queue.pending") == 6
+    hist = fr2.registry.histogram("dispatch.ms")
+    assert hist.count == 1 and hist.sum == 3.0
+    recs = fr2.stream.records(kind="event")
+    assert recs[-1]["name"] == "queue.recover" and recs[-1]["restored"] is True
+    # no barrier survived → zeroed registry is the right seed, and the
+    # recover event still lands (the validator's baseline reset)
+    fr3 = FlightRecorder(directory=str(tmp_path))
+    assert fr3.restore_at(generation=None) is False
+    assert fr3.registry.value("slo.tenant_gens") == 0
+    assert fr3.stream.records(kind="event")[-1]["restored"] is False
+
+
+# ---------------------------------------------------------------- pod merge
+
+
+def test_merge_pod_streams_aligns_and_validates(tmp_path):
+    """Two hand-built per-process streams sharing barrier names merge
+    into one trace with named tracks on disjoint PID_STRIDE ranges and
+    one aggregated stream — both green under check_report."""
+    dirs = []
+    for p in range(2):
+        d = tmp_path / f"p{p}"
+        fr = FlightRecorder(
+            directory=str(d), process_id=p, process_count=2
+        )
+        for g in (2, 4):
+            fr.count("slo.tenant_gens", 8)
+            fr.set("worker.sigma", 0.5 + p)
+            fr.barrier(f"pod:g{g}")
+            fr.sample(generation=g)
+        fr.event("worker.done", rank=p)
+        dirs.append(d)
+    trace_path = tmp_path / "pod_trace.json"
+    merged_path = tmp_path / "pod_metrics.jsonl"
+    out = merge_pod_streams(
+        dirs, trace_path=str(trace_path), merged_stream_path=str(merged_path)
+    )
+    assert out["processes"] == 2 and len(out["offsets_s"]) == 2
+    assert out["offsets_s"][0] == 0.0  # anchored in process 0's clock
+    events = out["trace"]["traceEvents"]
+    names = {
+        e["args"]["name"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert names == {"process 0: metrics", "process 1: metrics"}
+    pids = {e["pid"] for e in events}
+    assert pids == {0, PID_STRIDE}  # the deterministic stride mapping
+    # barriers land at the same merged instant (the alignment law)
+    anchor = [
+        e["ts"]
+        for e in events
+        if e["ph"] == "i" and e["name"] == "barrier:pod:g2"
+    ]
+    assert len(anchor) == 2 and anchor[0] == pytest.approx(anchor[1], abs=1.0)
+    # the aggregated stream interleaves both processes, aligned order
+    merged = out["records"]
+    assert {r["process_id"] for r in merged} == {0, 1}
+    aligned = [r["tm_aligned"] for r in merged]
+    assert aligned == sorted(aligned)
+    assert check_report.validate_file(str(merged_path)) == []
+    assert check_report.validate_file(str(trace_path)) == []
+
+
+def test_merge_without_common_barrier_uses_zero_offsets(tmp_path):
+    for p in range(2):
+        fr = FlightRecorder(
+            directory=str(tmp_path / f"p{p}"), process_id=p, process_count=2
+        )
+        fr.barrier(f"solo:g{p}")  # no name in common
+        fr.sample(generation=p)
+    out = merge_pod_streams([tmp_path / "p0", tmp_path / "p1"])
+    assert out["offsets_s"] == [0.0, 0.0]
+
+
+def test_read_stream_skips_torn_tail_without_repair(tmp_path):
+    fr = FlightRecorder(directory=str(tmp_path))
+    fr.sample(generation=0)
+    path = fr.stream.path
+    raw = path.read_bytes()
+    path.write_bytes(raw + b'{"kind": "sample", "tm"')  # live torn append
+    recs = read_stream(tmp_path)
+    assert [r["kind"] for r in recs] == ["meta", "sample"]
+    # read-only: the torn bytes are still on disk for the owner to repair
+    assert path.read_bytes().endswith(b'{"kind": "sample", "tm"')
+
+
+# --------------------------------------------------------- trace pid mapping
+
+
+def test_write_chrome_trace_pid_mapping_is_deterministic(tmp_path):
+    """PR-16 satellite: ``pid = PID_STRIDE * process_index + track`` and
+    worker tracks carry a ``pN:`` name prefix, so per-process traces
+    merge without collision."""
+    from evox_tpu.core.instrument import write_chrome_trace
+
+    counters = {"farm/alive": [(0.0, 2.0), (0.5, 1.0)]}
+    out = tmp_path / "t2.json"
+    trace = write_chrome_trace(
+        str(out), extra_counters=counters, process_index=2
+    )
+    events = trace["traceEvents"]
+    assert events, "extra_counters must produce a host-counters track"
+    assert all(200 <= e["pid"] < 300 for e in events)
+    metas = [
+        e for e in events if e["ph"] == "M" and e["name"] == "process_name"
+    ]
+    assert metas and all(
+        e["args"]["name"].startswith("p2: ") for e in metas
+    )
+    # process 0 keeps unprefixed names (the single-process common case)
+    trace0 = write_chrome_trace(
+        str(tmp_path / "t0.json"), extra_counters=counters, process_index=0
+    )
+    names0 = [
+        e["args"]["name"]
+        for e in trace0["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    ]
+    assert names0 == ["host counters"]
+    assert check_report.validate_file(str(out)) == []
+
+
+# ------------------------------------------------------- post-mortem tapes
+
+
+def test_run_supervisor_abort_carries_flight_recorder_tail():
+    """Every RunSupervisor post-mortem ends with the black-box tape:
+    the ring tail, closed by the supervisor.abort event itself."""
+    fr = FlightRecorder(ring_capacity=8)
+    fr.event("svc.before", note=1)
+    wf = _mk_wf()
+    state = wf.init(jax.random.PRNGKey(0))
+    wf.run = lambda *a, **kw: (_ for _ in ()).throw(
+        ValueError("poisoned dispatch")
+    )
+    sup = RunSupervisor(max_retries=0, backoff_s=0.01, metrics=fr)
+    with pytest.raises(RunAbortedError) as ei:
+        sup.run(wf, state, 4)
+    pm = ei.value.post_mortem
+    tape = pm["flight_recorder"]
+    assert tape, "abort post-mortem must carry the ring tail"
+    assert tape[0]["name"] == "svc.before"
+    assert tape[-1]["name"] == "supervisor.abort"
+    json.dumps(pm, allow_nan=False)  # post-mortems stay strict-JSON
+
+
+def test_pod_supervisor_failure_carries_flight_recorder_tail():
+    fr = FlightRecorder(ring_capacity=8)
+    fr.event("pod.before", note=1)
+    sup = PodSupervisor(
+        deadline_s=0.2, heartbeat_interval_s=0.05, metrics=fr
+    )
+    sup.start()
+    try:
+        with pytest.raises(PodFailureError) as ei:
+            sup.supervised(lambda: time.sleep(5.0), entry="chunk")
+    finally:
+        sup.stop()
+    pm = ei.value.post_mortem
+    tape = pm["flight_recorder"]
+    assert tape and tape[0]["name"] == "pod.before"
+    assert any(r.get("name", "").startswith("pod.") for r in tape[1:])
+    json.dumps(pm, allow_nan=False)

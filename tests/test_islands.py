@@ -223,6 +223,7 @@ def test_islands_with_eval_monitor():
     assert topk.shape == (3,)
 
 
+@pytest.mark.slow
 def test_islands_compose_with_fused_kernel_engine():
     """Islands + the fused Pallas rollout engine: the flattened
     cross-island batch goes through the kernel (interpret mode on CPU)

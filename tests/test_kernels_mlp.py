@@ -56,7 +56,11 @@ def _walker_setup(n, ep=1, max_steps=12, seed=0):
     return penv, penv.to_planes(env_flat)
 
 
-@pytest.mark.parametrize("early_stop", [True, False], ids=["while", "fori"])
+@pytest.mark.parametrize(
+    "early_stop",
+    [pytest.param(True, marks=pytest.mark.slow), False],
+    ids=["while", "fori"],
+)
 # n=150 is the stress shape; the n=5 variants carry the exactness law in
 # tier-1 (ISSUE 14 gate-headroom: the PR-2 slow-marking discipline)
 @pytest.mark.parametrize(
@@ -191,6 +195,7 @@ def test_fused_planes_multichip_shard_map():
     np.testing.assert_allclose(centers[0], centers[1], rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fused_planes_low_rank_linear_matches_scan():
     """A rank-r factorized input layer (linear_layers=(0,)) runs through
     the fused kernel bit-compatibly with the scan engine — the PERF_NOTES

@@ -230,6 +230,7 @@ def _peak_bytes(compiled):
     )
 
 
+@pytest.mark.slow
 def test_per_device_memory_scales_as_pop_over_ndev():
     """The tentpole acceptance: per-device peak bytes of the compiled
     sharded step sit well below the full-pop z bytes (and below the
@@ -276,6 +277,7 @@ def test_compiled_hlo_is_gather_free():
 # ------------------------------------------------------ convergence at scale
 
 
+@pytest.mark.slow
 def test_sharded_sepcmaes_converges_sphere_pop1e5():
     """CLAUDE.md convergence-threshold rule at pop=1e5 on the 8-device
     mesh (tier-1 shape of the million-scale workload)."""
@@ -457,6 +459,7 @@ def test_sharded_on_tenant_pop_2d_mesh():
     assert jnp.allclose(s2.algo.C, sr.algo.C, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_sharded_custom_axis_name():
     """A mesh whose pop axis is named differently: the annotations'
     canonical POP_AXIS is renamed to the wrapper's axis_name in init
@@ -483,6 +486,7 @@ def test_sharded_custom_axis_name():
     jax.jit(g.tell)(gs, jnp.sum(p**2, axis=1))
 
 
+@pytest.mark.slow
 def test_run_report_sharding_section():
     """The v5 roofline.sharding subsection: per-device peak < full-pop
     bytes for an instrumented sharded run, and the schema validator
@@ -496,7 +500,8 @@ def test_run_report_sharding_section():
     s = wf.run(s, 12)
     rec.fetch(s.algo.sigma, name="sigma")
     report = run_report(wf, s, recorder=rec)
-    assert report["schema"] == "evox_tpu.run_report/v10"
+    assert report["schema"] == "evox_tpu.run_report/v11"
+    assert report["schema_version"] == 11
     shd = report["roofline"]["sharding"]
     assert shd["axis"] == POP_AXIS and shd["n_devices"] == N_DEV
     assert shd["gather_free"] is True

@@ -42,6 +42,7 @@ of the bundled artifact's transfer under THIS build.
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from evox_tpu.algorithms.so.es import LES, OpenES
 from evox_tpu.algorithms.so.es.les_meta import load_params
@@ -77,6 +78,7 @@ def _run(algo, prob, key, shape_fitness):
     return jnp.log10(best + 1e-8)
 
 
+@pytest.mark.slow
 def test_les_cec2022_standing():
     """On the unseen CEC2022 members the meta-trained LES must (a) beat
     OpenES, its closest algorithmic relative, at the same budget on every

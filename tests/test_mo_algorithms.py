@@ -148,6 +148,7 @@ def test_knea_dtlz2_igd():
     assert _igd_after(algo, DTLZ2(d=DIM, m=M), 100) < 0.3
 
 
+@pytest.mark.slow
 def test_bige_zdt1_igd():
     zdt_dim = 12
     algo = BiGE(jnp.zeros(zdt_dim), jnp.ones(zdt_dim), n_objs=2, pop_size=100)

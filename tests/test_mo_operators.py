@@ -3,6 +3,7 @@ tests/test_non_dominated_sort.py and tests/test_crowding_distance.py."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from evox_tpu.operators.selection.non_dominate import (
     crowding_distance,
@@ -80,6 +81,7 @@ def test_non_dominated_sort_many_objectives():
     np.testing.assert_array_equal(np.asarray(rank == 0), ~dominated)
 
 
+@pytest.mark.slow
 def test_non_dominated_sort_sharded_matches_replicated():
     """The mesh-sharded sort (row-sharded packed dominance + psum peel)
     must be bit-identical to the replicated path, including the cut rank,
@@ -101,6 +103,7 @@ def test_non_dominated_sort_sharded_matches_replicated():
         assert int(c0) == int(c1)
 
 
+@pytest.mark.slow
 def test_rank_crowding_truncate_sharded_matches_replicated():
     import jax
 

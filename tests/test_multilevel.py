@@ -236,6 +236,7 @@ def test_hyperspec_validation():
 # ------------------------------------------------ real worker-process loss
 
 @pytest.mark.farm
+@pytest.mark.slow
 def test_multilevel_survives_worker_sigkill():
     """ISSUE-13 acceptance: a multi-level run over a REAL 2-worker
     ProcessRolloutFarm survives one injected worker-process loss

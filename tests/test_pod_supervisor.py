@@ -377,7 +377,8 @@ def test_pod_shrink_resume_8_to_4_analog(tmp_path):
 
         # v9 report + trace schema, incl. reform↔resume coherence
         rep = run_report(wf1, final)
-        assert rep["schema"] == "evox_tpu.run_report/v10"
+        assert rep["schema"] == "evox_tpu.run_report/v11"
+        assert rep["schema_version"] == 11
         pod = rep["pod_supervisor"]
         assert pod["outcome"] == "resumed"
         kinds = [e["event"] for e in pod["events"]]
@@ -435,6 +436,7 @@ os._exit(0)
 
 
 @pytest.mark.pod_chaos
+@pytest.mark.slow
 def test_process_barrier_timeout_names_missing_process():
     """ISSUE 14 satellite: a barrier with a REAL non-arriving peer
     raises the classified BarrierTimeoutError naming the process that

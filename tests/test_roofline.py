@@ -121,7 +121,7 @@ def test_roofline_merge_noop_when_disabled():
     report = run_report(wf, state, recorder=rec)
     assert "roofline" not in report
     assert set(report) == {
-        "schema", "generation", "telemetry", "dispatch",
+        "schema", "schema_version", "generation", "telemetry", "dispatch",
     }
 
 

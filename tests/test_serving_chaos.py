@@ -26,8 +26,12 @@ Laws under test:
 """
 
 import json
+import multiprocessing
+import os
 import shutil
 import signal
+import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +40,9 @@ import pytest
 from evox_tpu import (
     CheckpointConfigError,
     FleetHealthPolicy,
+    FlightRecorder,
     JournalIntegrityError,
+    MetricsStream,
     RunJournal,
     RunQueue,
     TenantSpec,
@@ -82,7 +88,7 @@ def test_journal_chain_roundtrip(tmp_path):
 
 def test_journal_rejects_unknown_kind(tmp_path):
     j = RunJournal(str(tmp_path))
-    with pytest.raises(ValueError, match="unknown journal event kind"):
+    with pytest.raises(ValueError, match="unknown RunJournal event kind"):
         j.append("reticulate", foo=1)
 
 
@@ -168,7 +174,9 @@ def _assert_crash_equivalent(journal_dir, reference):
 
 
 @pytest.mark.proc_chaos
-@pytest.mark.parametrize("kill_at", [2, 5])
+@pytest.mark.parametrize(
+    "kill_at", [pytest.param(2, marks=pytest.mark.slow), 5]
+)
 def test_driver_sigkill_at_chunk_boundary(tmp_path, reference, kill_at):
     """Tier-1 smoke of the crash law: the driver is SIGKILL'd right
     after chunk ``kill_at``'s barrier; recovery completes the sweep
@@ -267,7 +275,9 @@ def sla_reference(tmp_path_factory):
 
 
 @pytest.mark.proc_chaos
-@pytest.mark.parametrize("kill_at", [1, 3, 5])
+@pytest.mark.parametrize(
+    "kill_at", [1, 3, pytest.param(5, marks=pytest.mark.slow)]
+)
 def test_sla_preemption_sigkill_recovery(tmp_path, sla_reference, kill_at):
     """SLA preemption → journal → recover equivalence through a REAL
     driver SIGKILL. kill_at=1 dies right after the urgent MID-SWEEP
@@ -431,7 +441,7 @@ def test_recover_before_start(tmp_path):
 ISO_BUDGET = 9
 
 
-def _iso_sweep(tmp_path, action, poison_slot=None):
+def _iso_sweep(tmp_path, action, poison_slot=None, metrics_dir=None):
     wf = VectorizedWorkflow(
         CMAES(center_init=jnp.ones(pc.DIM), init_stdev=1.0, pop_size=pc.POP),
         Sphere(),
@@ -443,6 +453,7 @@ def _iso_sweep(tmp_path, action, poison_slot=None):
         chunk=3,
         journal=str(tmp_path),
         health_policy=FleetHealthPolicy(on_nonfinite=action),
+        metrics=None if metrics_dir is None else str(metrics_dir),
     )
     for i in range(pc.N_TENANTS):
         q.submit(TenantSpec(seed=i, n_steps=ISO_BUDGET, tag=f"t{i}"))
@@ -469,7 +480,10 @@ def iso_baseline(tmp_path_factory):
 
 
 @pytest.mark.chaos
-@pytest.mark.parametrize("action", ["freeze", "evict", "restart"])
+@pytest.mark.parametrize(
+    "action",
+    ["freeze", "evict", pytest.param("restart", marks=pytest.mark.slow)],
+)
 def test_poisoned_tenant_isolated(tmp_path, iso_baseline, action):
     """One NaN-poisoned tenant: the fleet completes, the policy's action
     is visible in run_report, and every HEALTHY tenant's telemetry ring
@@ -503,6 +517,7 @@ def test_poisoned_tenant_isolated(tmp_path, iso_baseline, action):
 # ------------------------------------------------- policy machinery units
 
 
+@pytest.mark.slow
 def test_fleet_health_signals_guarded_fleet():
     """A guarded fleet exports the stacked wrapper counters as
     per-tenant signals (the device-side detector's verdicts): one jitted
@@ -552,3 +567,139 @@ def test_health_policy_decide_severity_and_escalation():
         FleetHealthPolicy(on_nonfinite="defenestrate")
     with pytest.raises(ValueError, match="max_restarts_per_slot"):
         FleetHealthPolicy(max_restarts_per_slot=-1)
+
+
+# ------------------------------------------- serving metrics plane (PR 16)
+#
+# The continuous-metrics law: a journaled sweep emits a durable
+# hash-chained metrics stream whose SLO ledger is validated by
+# tools/check_report.py and coherent with the queue's own counters; a
+# SIGKILL mid-append leaves at worst a torn tail the next reader
+# repairs; `metrics=None` is an exact no-op (bit-identical results,
+# zero stream files anywhere).
+
+
+def test_metrics_sweep_slo_ledger_and_exact_noop(tmp_path, reference):
+    """The canonical 12-spec sweep with the flight recorder attached:
+    results are BIT-identical to the unmetered reference run (the
+    metrics plane is host-side only), the stream validates, and the SLO
+    ledger agrees with the queue's own counters and served work."""
+    mdir = tmp_path / "metrics"
+    q = pc.build_queue(tmp_path / "journal", metrics_dir=mdir)
+    pc.submit_all(q)
+    results = q.run()
+    # exact-no-op law, both directions: the metered run changed nothing
+    # observable, and the unmetered reference wrote no stream at all
+    assert pc.result_digest(results) == reference["digest"]
+    assert not list(reference["dir"].rglob(MetricsStream.FILENAME))
+    stream_path = q.metrics.stream.path
+    assert stream_path.exists()
+    assert check_report.validate_file(str(stream_path)) == []
+    # the SLO ledger's coherence: admissions with the queue's counter,
+    # tenant-gens with the work actually served
+    total_gens = sum(r["generations"] for r in results)
+    led = q.metrics.slo_ledger()
+    assert led["admissions"] == q.counters["admitted"] == len(pc.BUDGETS)
+    assert led["tenant_gens"] == total_gens
+    assert led["tenant_gens_per_s"] > 0
+    # one sample per chunk, at the dispatch boundary
+    samples = q.metrics.stream.records(kind="sample")
+    assert len(samples) == q.counters["chunks"]
+    assert samples[-1]["queue"]["retired"] == q.counters["retired"]
+    # run_report picks the recorder up through the workflow backref
+    rep = run_report(q.workflow, q.state)
+    assert rep["schema_version"] == 11
+    assert rep["metrics"]["counters"]["slo.tenant_gens"] == total_gens
+    assert rep["metrics"]["stream"]["records"] == len(q.metrics.stream.records())
+    assert rep["slo"]["admissions"] == len(pc.BUDGETS)
+    assert check_report.validate_run_report(rep) == []
+
+
+def test_queue_evict_post_mortem_carries_tail(tmp_path):
+    """Every queue post-mortem carries the black-box tape: the evicted
+    tenant's close-out entry ends with its own queue.evicted event."""
+    q = _iso_sweep(
+        tmp_path / "journal",
+        "evict",
+        poison_slot=1,
+        metrics_dir=tmp_path / "metrics",
+    )
+    entry = next(r for r in q.results if r["tag"] == "t1")
+    assert entry["status"] == "evicted"
+    tape = entry["flight_recorder"]
+    assert tape, "evict close-out must carry the ring tail"
+    assert tape[-1]["name"] == "queue.evicted"
+    assert tape[-1]["tag"] == "t1"
+    assert q.metrics.registry.value("health.evict") == 1
+    assert check_report.validate_file(str(q.metrics.stream.path)) == []
+
+
+@pytest.mark.proc_chaos
+def test_metrics_stream_sigkill_mid_append(tmp_path):
+    """SIGKILL a child that is doing nothing but appending metrics:
+    adoption repairs at most one torn tail, the chain stays appendable,
+    and the repaired stream validates green."""
+    sdir = tmp_path / "stream"
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(
+        target=pc.metrics_child_main, args=(str(sdir),), daemon=True
+    )
+    p.start()
+    path = sdir / MetricsStream.FILENAME
+    deadline = time.time() + 120.0
+    grown = False
+    while time.time() < deadline:
+        if path.exists() and path.stat().st_size > 20_000:
+            grown = True
+            break
+        time.sleep(0.02)
+    if not grown:
+        p.kill()
+        p.join()
+        pytest.fail("metrics child produced no stream growth")
+    os.kill(p.pid, signal.SIGKILL)
+    p.join()
+    assert p.exitcode == -signal.SIGKILL
+    # adoption: the kill may or may not have landed mid-write, so the
+    # torn-tail warning is optional — at most ONE record is lost either
+    # way (per-record fsync), and the file is physically repaired
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        stream = MetricsStream(str(sdir))
+    assert stream.torn_tail_dropped in (0, 1)
+    samples = stream.records(kind="sample")
+    assert len(samples) >= 3
+    # the child counted 3 gens then sampled, every iteration: the last
+    # surviving sample's counter is exactly 3x its generation
+    last = samples[-1]
+    assert last["counters"]["slo.tenant_gens"] == 3 * last["generation"]
+    # the chain stays appendable across the crash, and validates
+    fr = FlightRecorder(directory=str(sdir))
+    fr.event("svc.recovered")
+    assert len(fr.stream.records(kind="meta")) == 1
+    assert check_report.validate_file(str(path)) == []
+
+
+@pytest.mark.slow
+@pytest.mark.proc_chaos
+def test_metrics_sweep_sigkill_recovery(tmp_path, reference):
+    """The crash-equivalence law extended to the metrics plane: after a
+    driver SIGKILL at a chunk boundary, ``recover(metrics=...)``
+    restores the registry to the recovered barrier's sample, stamps the
+    queue.recover baseline reset, and the finished ledger converges to
+    the uncrashed run's."""
+    jd, md = tmp_path / "journal", tmp_path / "metrics"
+    code = pc.run_driver(jd, kill_after_chunks=2, metrics_dir=md)
+    assert code == -signal.SIGKILL
+    q = RunQueue.recover(pc.build_workflow(), str(jd), metrics=str(md))
+    q.run()
+    assert pc.result_digest(q.results) == reference["digest"]
+    events = q.metrics.stream.records(kind="event")
+    recover = [r for r in events if r["name"] == "queue.recover"]
+    assert len(recover) == 1 and recover[0]["restored"] is True
+    # the whole two-run stream — crashed stretch, baseline reset,
+    # replayed stretch — validates as one file
+    assert check_report.validate_file(str(q.metrics.stream.path)) == []
+    led = q.metrics.slo_ledger()
+    assert led["admissions"] == q.counters["admitted"] == len(pc.BUDGETS)
+    assert led["tenant_gens"] == sum(r["generations"] for r in q.results)

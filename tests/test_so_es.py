@@ -198,6 +198,7 @@ def _les_benchmark_run(algo, eval_fn, task, key, gens, shape):
     return jnp.log10(jnp.min(bests) + 1e-10)
 
 
+@pytest.mark.slow
 def test_les_meta_trained_beats_random_and_openes():
     """The bundled meta-trained parameters (les_meta.py, the in-repo
     replacement for the reference's evosax pickle — reference

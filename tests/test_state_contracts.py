@@ -308,7 +308,18 @@ KNOWN_UNVMAPPABLE = set()
 # run slow-marked: the mechanical contract keeps full tier-1 breadth via
 # every other registered algorithm, and the full suite still sweeps all
 # (ISSUE 14 gate-headroom, the PR-2 slow-marking discipline)
-_VMAP_CONTRACT_SLOW = {"CoDE", "IMMOEA", "KnEA"}
+_VMAP_CONTRACT_SLOW = {
+    "BCEIBEA",
+    "BiGE",
+    "CoDE",
+    "EAGMOEAD",
+    "IBEA",
+    "IMMOEA",
+    "KnEA",
+    "LMOCSO",
+    "MOEADM2M",
+    "RVEAa",
+}
 
 
 @pytest.mark.parametrize(

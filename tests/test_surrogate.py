@@ -293,6 +293,7 @@ def test_disabled_bitwise_step_and_fused_run_on_mesh():
         )
 
 
+@pytest.mark.slow
 def test_disabled_bitwise_pipelined():
     """The third driver of the acceptance criterion: the pipelined host
     path (executor-driven) is bitwise too, monitors included."""
@@ -321,6 +322,7 @@ def test_disabled_bitwise_pipelined():
     ].fingerprint(sd.monitors[0])
 
 
+@pytest.mark.slow
 def test_enabled_run_equals_step_on_mesh():
     """The ENABLED path honors the repo's run==step law too: the fused
     fori_loop trace of the screening step is bitwise the eager step
@@ -643,7 +645,8 @@ def test_run_report_surrogate_section_and_validator():
     state = wf.init(jax.random.PRNGKey(7))
     state = ex.run_host(wf, state, 6)
     report = run_report(wf, state, recorder=rec, executor=ex)
-    assert report["schema"] == "evox_tpu.run_report/v10"
+    assert report["schema"] == "evox_tpu.run_report/v11"
+    assert report["schema_version"] == 11
     sur = report["surrogate"]
     assert sur["enabled"] is True and sur["model"] == "ensemble"
     c = sur["counters"]

@@ -82,6 +82,7 @@ def _tree_allclose(a, b, rtol=1e-5, atol=1e-6):
 # --------------------------------------------------------------- equivalence
 
 
+@pytest.mark.slow
 def test_fleet_matches_solo_cmaes():
     """Each tenant's trajectory == a solo run of its (seed, hyperparams),
     with per-tenant init_stdev bound through the traced step."""
@@ -145,6 +146,7 @@ def test_fleet_sphere_convergence():
     assert (best < 1e-2).all(), f"fleet best per tenant: {best}"
 
 
+@pytest.mark.slow
 def test_fleet_init_hooks_mo():
     """An init_ask/init_tell algorithm (NSGA-II evaluates its parents
     first) vmaps through the fleet's peeled first step; tenant 0 matches
@@ -335,6 +337,7 @@ def test_insert_tenant_roundtrip():
 # ------------------------------------------------------------------- chaos
 
 
+@pytest.mark.slow
 def test_supervisor_chaos_fleet():
     """PR-5 law through the fleet path: a transient dispatch fault is
     retried from the immutable entry state and the healed run is
@@ -363,6 +366,7 @@ def test_supervisor_chaos_fleet():
     assert fp_clean == fp_healed
 
 
+@pytest.mark.slow
 def test_supervisor_restore_meshed_fleet(tmp_path):
     """The restore rung re-places a fleet snapshot by the TENANT-prefixed
     layout (VectorizedWorkflow.place_restored, duck-typed by the
@@ -671,7 +675,8 @@ def test_run_report_tenancy_section_valid():
                             hyperparams={"init_stdev": 1.0}))
     q.run()
     report = run_report(wf, q.state)
-    assert report["schema"] == "evox_tpu.run_report/v10"
+    assert report["schema"] == "evox_tpu.run_report/v11"
+    assert report["schema_version"] == 11
     ten = report["tenancy"]
     assert ten["n_tenants"] == 2
     assert ten["leading_axes"] == [2]

@@ -49,6 +49,7 @@ def test_kernel_matches_lax_topk_exactly(n, k, bs):
     np.testing.assert_array_equal(np.asarray(ri), np.asarray(ki))
 
 
+@pytest.mark.slow
 def test_kernel_tie_law_on_duplicate_heavy_input():
     """Quantized values force cross-block value ties: the block-major,
     rank-ordered candidate layout must preserve lax.top_k's
@@ -126,6 +127,7 @@ def _truncation_sets_agree(fit, k):
     ), "per-survivor ranks differ"
 
 
+@pytest.mark.slow
 def test_rank_crowding_truncate_kernel_set_identical():
     """The kernel path admits EXACTLY the lexsort path's survivor set
     (whole better fronts + crowding-selected cut front, ties by lowest

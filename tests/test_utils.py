@@ -33,6 +33,7 @@ def _nested_tree(key):
     }
 
 
+@pytest.mark.slow
 def test_tree_and_vector_roundtrip():
     tree = _nested_tree(jax.random.PRNGKey(0))
     adapter = TreeAndVector(tree)

@@ -226,6 +226,11 @@ def main() -> None:
             assert "single-process" in str(e), e
         result["laws"]["external_refusal"] = "ok"
 
+    # --- phase 2b: pod metrics tier (Tier A — KV-store barriers + a
+    # replicated workload, so it runs on ANY jaxlib, every leg) ------------
+    if spec.get("metrics", False):
+        _metrics_tier(spec, result, dist, nprocs, n_local, workdir)
+
     # --- phase 3: Tier B (cross-process computations) ---------------------
     if spec.get("collectives", False) or nprocs == 1:
         _collective_laws(spec, result, dist, mesh, nprocs, n_local, workdir)
@@ -418,6 +423,80 @@ def _collective_laws(spec, result, dist, mesh, nprocs, n_local, workdir):
         }
 
 
+def _metrics_tier(spec, result, dist, nprocs, n_local, workdir):
+    """PR-16 pod-metrics law: every process drives a real workload with
+    its own :class:`FlightRecorder` stream, stamping ``barrier`` records
+    only AFTER the KV-store rendezvous (``dist.process_barrier`` — no
+    XLA collective, so this tier is Tier A on any jaxlib) releases; the
+    stamps then bracket a true cross-process alignment instant. Process
+    0 merges the per-process streams into ONE named-track Perfetto
+    trace plus an aggregated stream and runs both artifacts through the
+    public validator (tools/check_report.py)."""
+    import jax
+
+    from evox_tpu.workflows.flightrec import FlightRecorder, merge_pod_streams
+
+    pid = int(spec["pid"])
+    # per-LEG namespace: the solo leg and the pod leg share workdir, and
+    # a recorder pointed at an existing stream would adopt and APPEND a
+    # second run whose counters restart — a legal-looking file the
+    # monotonicity law correctly rejects
+    mdir = os.path.join(workdir, f"metrics_{nprocs}x{n_local}")
+    fr = FlightRecorder(directory=os.path.join(mdir, f"p{pid}"))
+    assert fr.process_id == pid and fr.process_count == nprocs, (
+        "FlightRecorder mis-detected pod identity",
+        fr.process_id,
+        fr.process_count,
+    )
+    # replicated twin of the law workload: identical trajectory on every
+    # process, no collective — the metrics plane is what's under test
+    wf = _law_workflow(None, nprocs * n_local)
+    state = wf.init(jax.random.PRNGKey(3))
+    chunk, total = 2, 6
+    for _ in range(0, total, chunk):
+        t0 = time.perf_counter()
+        state = wf.run(state, chunk)
+        sigma = float(dist.host_value(state.algo.sigma))  # real fetch
+        fr.count("slo.tenant_gens", chunk)
+        fr.observe("worker.chunk_ms", (time.perf_counter() - t0) * 1e3)
+        fr.set("worker.sigma", sigma)
+        g = int(state.generation)
+        dist.process_barrier(f"metrics_g{g}", timeout_s=120.0)
+        fr.barrier(f"pod:metrics_g{g}")
+        fr.sample(generation=g)
+    fr.event("worker.done", generation=int(state.generation))
+    info = {"stream": fr.stream.report()}
+    # every stream must be durably complete before process 0 reads them
+    dist.process_barrier("metrics_merge", timeout_s=120.0)
+    if pid == 0:
+        dirs = [os.path.join(mdir, f"p{p}") for p in range(nprocs)]
+        trace_path = os.path.join(mdir, "pod_trace.json")
+        merged_path = os.path.join(mdir, "pod_metrics.jsonl")
+        merged = merge_pod_streams(
+            dirs, trace_path=trace_path, merged_stream_path=merged_path
+        )
+        names = {
+            e["args"]["name"]
+            for e in merged["trace"]["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        want = {f"process {p}: metrics" for p in range(nprocs)}
+        assert want <= names, (names, want)
+        assert merged["processes"] == nprocs
+        assert len(merged["offsets_s"]) == nprocs, merged["offsets_s"]
+        errs = _validate_files(spec["repo"], [merged_path, trace_path])
+        assert not errs, errs
+        info["merged"] = {
+            "processes": merged["processes"],
+            "offsets_s": merged["offsets_s"],
+            "records": len(merged["records"]),
+            "trace_events": len(merged["trace"]["traceEvents"]),
+            "named_tracks": sorted(names),
+            "validated": ["pod_metrics.jsonl", "pod_trace.json"],
+        }
+    result["metrics"] = info
+
+
 def _dump(result, workdir, tag):
     path = os.path.join(workdir, f"result_{tag}.json")
     with open(path + ".tmp", "w") as f:
@@ -516,11 +595,21 @@ def _pod_run(spec: dict, result: dict, pr: dict) -> None:
 
     mesh = dist.create_pod_mesh() if pr.get("sharded") else None
     wf = _law_workflow(mesh, int(pr["n_shards"]), pop=int(pr.get("pop", 32)))
+    # the pod flight recorder: pod.* transitions, supervised-barrier
+    # stamps, and the black-box tail every classified post-mortem must
+    # carry (PR-16 pod law) — stream under the pod's own subdir so a
+    # re-formed epoch appends to a fresh directory
+    from evox_tpu.workflows.flightrec import FlightRecorder
+
+    fr = FlightRecorder(
+        directory=os.path.join(subdir, f"metrics_e{epoch}_p{pid}")
+    )
     sup = PodSupervisor(
         deadline_s=deadline_s,
         heartbeat_interval_s=float(pr.get("hb_interval_s", 0.2)),
         journal=os.path.join(subdir, "pod_journal"),
         epoch=epoch,
+        metrics=fr,
     ).start()
     sup.install_sigterm_drain()
     if pr.get("resume"):
@@ -559,7 +648,7 @@ def _pod_run(spec: dict, result: dict, pr: dict) -> None:
     if pr.get("resume"):
         state = sup.resume_from_barrier(wf, ck, expect_like=state)
         resume_generation = int(state.generation)
-    ex = GenerationExecutor(pod_supervisor=sup)
+    ex = GenerationExecutor(pod_supervisor=sup, metrics=fr)
     try:
         state = ex.run_fused(
             wf,
@@ -584,7 +673,7 @@ def _pod_run(spec: dict, result: dict, pr: dict) -> None:
         sys.stdout.flush()
         os._exit(POD_FAULT_EXIT)
 
-    report = run_report(wf, state)
+    report = run_report(wf, state, metrics=fr)
     result["pod"] = {
         "status": sup.report()["outcome"],
         "generation": int(state.generation),
@@ -601,18 +690,35 @@ def _pod_run(spec: dict, result: dict, pr: dict) -> None:
     sup.stop()
 
 
+def _load_validator(repo: str):
+    import importlib.util
+
+    cr_spec = importlib.util.spec_from_file_location(
+        "evox_tpu_check_report", os.path.join(repo, "tools", "check_report.py")
+    )
+    cr = importlib.util.module_from_spec(cr_spec)
+    cr_spec.loader.exec_module(cr)
+    return cr
+
+
 def _validate_report(repo: str, report: dict):
-    """Worker-side schema check of the v9 run_report (the chaos tier's
+    """Worker-side schema check of the run_report (the chaos tier's
     reports never reach the in-process validator tests otherwise)."""
     try:
-        import importlib.util
+        return _load_validator(repo).validate_run_report(report)
+    except Exception as e:  # pragma: no cover - validator load failure
+        return [f"validator unavailable: {type(e).__name__}: {e}"]
 
-        cr_spec = importlib.util.spec_from_file_location(
-            "evox_tpu_check_report", os.path.join(repo, "tools", "check_report.py")
-        )
-        cr = importlib.util.module_from_spec(cr_spec)
-        cr_spec.loader.exec_module(cr)
-        return cr.validate_run_report(report)
+
+def _validate_files(repo: str, paths):
+    """Worker-side ``check_report.validate_file`` over merged metrics
+    artifacts (stream .jsonl + Perfetto trace .json)."""
+    try:
+        cr = _load_validator(repo)
+        errs = []
+        for p in paths:
+            errs += [f"{os.path.basename(p)}: {e}" for e in cr.validate_file(p)]
+        return errs
     except Exception as e:  # pragma: no cover - validator load failure
         return [f"validator unavailable: {type(e).__name__}: {e}"]
 
@@ -939,6 +1045,9 @@ class PodManager:
                             "detect_s": pm["detect_s"],
                             "census": pm.get("census"),
                             "entry": pm.get("entry"),
+                            "flight_recorder_tail": len(
+                                pm.get("flight_recorder") or []
+                            ),
                         }
                     )
                 elif coordinator_dead and e["rc"] not in (0, None):
@@ -954,6 +1063,14 @@ class PodManager:
                 all(d["classification"] == expected for d in detections),
                 f"classification mismatch: wanted {expected}, got "
                 f"{[d['classification'] for d in detections]}",
+                entries,
+            )
+            # PR-16 pod law: every classified post-mortem carries the
+            # flight-recorder black-box tail
+            self._require(
+                all(d["flight_recorder_tail"] > 0 for d in detections),
+                f"post-mortem missing flight-recorder tail: "
+                f"{[d['flight_recorder_tail'] for d in detections]}",
                 entries,
             )
             budget = deadline_s + 2.0 * (2.0 * hb_interval_s + 0.2) + 10.0

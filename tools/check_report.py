@@ -30,6 +30,19 @@ import sys
 from typing import Any, Iterator, List, Tuple
 
 RUN_REPORT_SCHEMA_PREFIX = "evox_tpu.run_report/"
+# v11 (PR 16, workflows/flightrec.py): the serving metrics stream is a
+# third .jsonl surface — sniffed by its per-record schema tag
+METRICS_STREAM_SCHEMA_PREFIX = "evox_tpu.metrics_stream/"
+STREAM_KINDS = {"meta", "sample", "event", "barrier"}
+SLO_KEYS = (
+    "tenant_gens",
+    "elapsed_s",
+    "tenant_gens_per_s",
+    "admissions",
+    "preemptions",
+    "deadline_hits",
+    "deadline_misses",
+)
 CLASSIFICATIONS = {"compute-bound", "memory-bound", "dispatch-bound", None}
 SUPERVISOR_OUTCOMES = {"clean", "recovered", "aborted"}
 SUPERVISOR_EVENTS = {"retry", "deadline", "restore", "degrade", "abort"}
@@ -109,6 +122,18 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
             schema_version = int(schema.rsplit("/v", 1)[1])
         except (IndexError, ValueError):
             schema_version = 1
+    # v11+: the version also rides as a grep-able top-level int, and the
+    # two must agree — a report that says v12 in one place and v11 in the
+    # other is lying to somebody
+    if schema_version >= 11:
+        sv = report.get("schema_version")
+        if not isinstance(sv, int):
+            errors.append(f"{where}: schema_version missing or not an int")
+        elif sv != schema_version:
+            errors.append(
+                f"{where}: schema_version {sv} disagrees with schema "
+                f"{schema!r}"
+            )
     errors += [f"{where}: non-finite number at {p}" for p in find_nonfinite(report)]
     for i, mon in enumerate(report.get("telemetry", []) or []):
         if not isinstance(mon, dict) or "monitor" not in mon:
@@ -201,6 +226,53 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     executor = report.get("executor")
     if executor is not None:
         errors += _validate_executor(executor, where)
+    metrics = report.get("metrics")
+    if metrics is not None:
+        errors += _validate_metrics_section(metrics, where)
+    slo = report.get("slo")
+    if slo is not None:
+        errors += _validate_slo_ledger(slo, where)
+        if isinstance(metrics, dict):
+            # the ledger IS the slo.* counter namespace rendered — the
+            # two views come from one registry, so they must agree
+            # exactly
+            counters = metrics.get("counters") or {}
+            for short, name in (
+                ("tenant_gens", "slo.tenant_gens"),
+                ("admissions", "slo.admissions"),
+                ("preemptions", "slo.preemptions"),
+                ("deadline_hits", "slo.deadline_hits"),
+                ("deadline_misses", "slo.deadline_misses"),
+            ):
+                if _num(slo.get(short)) and slo[short] != counters.get(
+                    name, 0
+                ):
+                    errors.append(
+                        f"{where}: slo.{short} {slo[short]} disagrees with "
+                        f"metrics.counters.{name} {counters.get(name, 0)}"
+                    )
+        queue = (tenancy or {}).get("queue") if isinstance(tenancy, dict) else None
+        qcounters = queue.get("counters") if isinstance(queue, dict) else None
+        if isinstance(qcounters, dict):
+            # the recorder counts admissions/preemptions at the queue's
+            # own call sites, but MAY be shared across bucket queues
+            # (ElasticServer), so the ledger dominates any single
+            # queue's counters — a ledger BELOW them is incoherent
+            for short, qkey in (
+                ("admissions", "admitted"),
+                ("preemptions", "preempted"),
+            ):
+                if (
+                    _num(slo.get(short))
+                    and _num(qcounters.get(qkey))
+                    and slo[short] < qcounters[qkey]
+                ):
+                    errors.append(
+                        f"{where}: slo.{short} {slo[short]} < "
+                        f"tenancy.queue.counters.{qkey} "
+                        f"{qcounters[qkey]} — the ledger lost admissions "
+                        "the queue itself recorded"
+                    )
     roofline = report.get("roofline")
     if roofline is not None:
         if not isinstance(roofline, dict):
@@ -1145,6 +1217,234 @@ def _validate_serving(serving: Any, where: str) -> List[str]:
     return errors
 
 
+def _validate_histogram(h: Any, loc: str) -> List[str]:
+    """One histogram snapshot: strictly-increasing buckets, cumulative
+    counts (non-decreasing across `le`, capped by the +Inf `count`)."""
+    errors: List[str] = []
+    if not isinstance(h, dict):
+        return [f"{loc} is not an object"]
+    le = h.get("le")
+    counts = h.get("counts")
+    if not isinstance(le, list) or not le or le != sorted(le) or len(
+        set(le)
+    ) != len(le):
+        errors.append(f"{loc}.le missing or not strictly increasing")
+    if not isinstance(counts, list) or (
+        isinstance(le, list) and len(counts) != len(le)
+    ):
+        errors.append(f"{loc}.counts missing or length != le")
+    elif any(not isinstance(c, int) or c < 0 for c in counts):
+        errors.append(f"{loc}.counts not non-negative ints")
+    elif any(b < a for a, b in zip(counts, counts[1:])):
+        errors.append(f"{loc}.counts not cumulative (a bucket decreased)")
+    total = h.get("count")
+    if not isinstance(total, int) or total < 0:
+        errors.append(f"{loc}.count missing or negative")
+    elif isinstance(counts, list) and counts and isinstance(
+        counts[-1], int
+    ) and counts[-1] > total:
+        errors.append(f"{loc}: last bucket exceeds the +Inf count")
+    if not _num(h.get("sum")):
+        errors.append(f"{loc}.sum missing or non-numeric")
+    return errors
+
+
+def _validate_metrics_section(metrics: Any, where: str) -> List[str]:
+    """The ``metrics`` section (schema v11, workflows/flightrec.py
+    FlightRecorder.report()): the registry snapshot plus ring/stream
+    accounting."""
+    errors: List[str] = []
+    if not isinstance(metrics, dict):
+        return [f"{where}: metrics is not an object"]
+    if metrics.get("enabled") is not True:
+        errors.append(f"{where}: metrics.enabled missing or not true")
+    for key in ("process_id", "process_count", "ring_len", "ring_capacity"):
+        v = metrics.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: metrics.{key} missing or not a non-negative int"
+            )
+    for group in ("counters", "gauges"):
+        d = metrics.get(group)
+        if not isinstance(d, dict):
+            errors.append(f"{where}: metrics.{group} missing")
+            continue
+        for name, v in d.items():
+            if not _num(v) or (group == "counters" and v < 0):
+                errors.append(f"{where}: metrics.{group}.{name} non-numeric")
+    hists = metrics.get("histograms")
+    if not isinstance(hists, dict):
+        errors.append(f"{where}: metrics.histograms missing")
+    else:
+        for name, h in hists.items():
+            errors += _validate_histogram(h, f"{where}: metrics.histograms.{name}")
+    stream = metrics.get("stream")
+    if stream is not None:
+        if not isinstance(stream, dict):
+            errors.append(f"{where}: metrics.stream is not an object")
+        else:
+            if not isinstance(stream.get("path"), str):
+                errors.append(f"{where}: metrics.stream.path missing")
+            for key in ("records", "torn_tail_dropped"):
+                v = stream.get(key)
+                if not isinstance(v, int) or v < 0:
+                    errors.append(
+                        f"{where}: metrics.stream.{key} missing or negative"
+                    )
+            events = stream.get("events")
+            if not isinstance(events, dict):
+                errors.append(f"{where}: metrics.stream.events missing")
+            else:
+                for kind in events:
+                    if kind not in STREAM_KINDS:
+                        errors.append(
+                            f"{where}: metrics.stream.events kind {kind!r} "
+                            f"not in {sorted(STREAM_KINDS)}"
+                        )
+    return errors
+
+
+def _validate_slo_ledger(slo: Any, where: str) -> List[str]:
+    """The top-level ``slo`` section (schema v11,
+    FlightRecorder.slo_ledger()): all keys present, non-negative, and
+    the derived rate arithmetically coherent with its numerator and
+    denominator."""
+    errors: List[str] = []
+    if not isinstance(slo, dict):
+        return [f"{where}: slo is not an object"]
+    for key in SLO_KEYS:
+        v = slo.get(key)
+        if not _num(v) or v < 0:
+            errors.append(f"{where}: slo.{key} missing or negative")
+    if all(_num(slo.get(k)) for k in ("tenant_gens", "elapsed_s", "tenant_gens_per_s")):
+        elapsed = max(float(slo["elapsed_s"]), 1e-9)
+        expect = float(slo["tenant_gens"]) / elapsed
+        got = float(slo["tenant_gens_per_s"])
+        if abs(got - expect) > max(0.01 * expect, 0.01):
+            errors.append(
+                f"{where}: slo.tenant_gens_per_s {got} incoherent with "
+                f"tenant_gens/elapsed_s ({expect:.6f})"
+            )
+    return errors
+
+
+def validate_metrics_stream(
+    records: List[Any], where: str = "metrics_stream"
+) -> List[str]:
+    """A metrics stream (``metrics.jsonl``, or the merged pod stream):
+    known record kinds, exactly the stream schema tag on every record, a
+    ``meta`` identity record, counters monotonically non-decreasing
+    across samples — with the baseline RESET at ``queue.recover`` events
+    (crash recovery replays a rolled-back stretch, so replayed counts
+    legally rewind) — and every sample's SLO ledger coherent with both
+    its own registry snapshot (exact: one registry, one instant) and any
+    ``queue`` context it carries (dominance: the recorder may serve
+    several bucket queues)."""
+    errors: List[str] = []
+    if not records:
+        return [f"{where}: empty stream"]
+    saw_meta = False
+    # per-process counter baselines: merged streams tag each record with
+    # its process_id; a single stream is one implicit process
+    baselines: dict = {}
+    for i, rec in enumerate(records):
+        loc = f"{where}: records[{i}]"
+        if not isinstance(rec, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        schema = rec.get("schema")
+        if not isinstance(schema, str) or not schema.startswith(
+            METRICS_STREAM_SCHEMA_PREFIX
+        ):
+            errors.append(
+                f"{loc}: schema {schema!r} is not a "
+                f"'{METRICS_STREAM_SCHEMA_PREFIX}*' tag"
+            )
+        kind = rec.get("kind")
+        if kind not in STREAM_KINDS:
+            errors.append(f"{loc}: kind {kind!r} not in {sorted(STREAM_KINDS)}")
+            continue
+        errors += [f"{loc}: non-finite number at {p}" for p in find_nonfinite(rec)]
+        proc = rec.get("process_id") if kind != "meta" else None
+        if kind == "meta":
+            saw_meta = True
+            for key in ("process_id", "process_count"):
+                if not isinstance(rec.get(key), int):
+                    errors.append(f"{loc}: meta.{key} missing")
+            continue
+        if not _num(rec.get("tm")) or rec["tm"] < 0:
+            errors.append(f"{loc}: tm missing/negative")
+        if kind == "event":
+            if not isinstance(rec.get("name"), str):
+                errors.append(f"{loc}: event name missing")
+            elif rec["name"] == "queue.recover":
+                # the recovered process re-counts the replayed stretch
+                # from the restored sample (or zero): every counter may
+                # rewind past samples the crash rolled back
+                baselines[proc] = {}
+            continue
+        if kind == "barrier":
+            if not isinstance(rec.get("name"), str):
+                errors.append(f"{loc}: barrier name missing")
+            if not _num(rec.get("t_wall")):
+                errors.append(f"{loc}: barrier t_wall missing")
+            continue
+        # kind == "sample"
+        counters = rec.get("counters")
+        if not isinstance(counters, dict):
+            errors.append(f"{loc}: sample.counters missing")
+            continue
+        base = baselines.setdefault(proc, {})
+        for name, v in counters.items():
+            if not _num(v) or v < 0:
+                errors.append(f"{loc}: counter {name!r} non-numeric/negative")
+                continue
+            if v < base.get(name, 0):
+                errors.append(
+                    f"{loc}: counter {name!r} decreased ({base[name]} -> "
+                    f"{v}) with no queue.recover between samples"
+                )
+            base[name] = v
+        for name, h in (rec.get("histograms") or {}).items():
+            errors += _validate_histogram(h, f"{loc}: histograms.{name}")
+        slo = rec.get("slo")
+        if slo is not None:
+            errors += _validate_slo_ledger(slo, loc)
+            if isinstance(slo, dict):
+                for short, name in (
+                    ("tenant_gens", "slo.tenant_gens"),
+                    ("admissions", "slo.admissions"),
+                    ("preemptions", "slo.preemptions"),
+                    ("deadline_hits", "slo.deadline_hits"),
+                    ("deadline_misses", "slo.deadline_misses"),
+                ):
+                    if _num(slo.get(short)) and slo[short] != counters.get(
+                        name, 0
+                    ):
+                        errors.append(
+                            f"{loc}: slo.{short} {slo[short]} disagrees "
+                            f"with counter {name} {counters.get(name, 0)}"
+                        )
+        queue = rec.get("queue")
+        if isinstance(queue, dict) and isinstance(slo, dict):
+            for short, qkey in (
+                ("admissions", "admitted"),
+                ("preemptions", "preempted"),
+            ):
+                if (
+                    _num(slo.get(short))
+                    and _num(queue.get(qkey))
+                    and slo[short] < queue[qkey]
+                ):
+                    errors.append(
+                        f"{loc}: slo.{short} {slo[short]} < queue.{qkey} "
+                        f"{queue[qkey]}"
+                    )
+    if not saw_meta:
+        errors.append(f"{where}: no meta record — stream lacks its identity")
+    return errors
+
+
 def validate_bench(summary: Any, where: str = "bench") -> List[str]:
     errors: List[str] = []
     if not isinstance(summary, dict):
@@ -1194,6 +1494,10 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             # ledger in the `surrogate` summary key is its static
             # referee
             ("surrogate", "its full-evaluation baseline ratio"),
+            # v11: the metrics_overhead leg's vs_baseline is the
+            # measured bare-vs-instrumented wall ratio — the PR-16
+            # <= 2% overhead law must be measured, not asserted
+            ("metrics-plane", "its uninstrumented-baseline ratio"),
         ):
             if keyword not in metric_l:
                 continue
@@ -1537,21 +1841,64 @@ def validate_chrome_trace(trace: Any, where: str = "trace") -> List[str]:
     return errors
 
 
+def _strict_loads(line: str) -> Any:
+    # strict: bare NaN/Infinity tokens must fail, exactly as they would
+    # in jq / JSON.parse
+    return json.loads(
+        line, parse_constant=lambda c: (_ for _ in ()).throw(
+            ValueError(f"non-strict JSON constant {c}")
+        )
+    )
+
+
+def _sniff_stream_jsonl(path: str) -> bool:
+    """True when a .jsonl file's first record carries the metrics-stream
+    schema tag — the dispatch key between run-report lines and a
+    FlightRecorder stream."""
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                obj = json.loads(line)
+                return isinstance(obj, dict) and str(
+                    obj.get("schema", "")
+                ).startswith(METRICS_STREAM_SCHEMA_PREFIX)
+    except ValueError:
+        pass
+    return False
+
+
 def validate_file(path: str) -> List[str]:
     if path.endswith(".jsonl"):
         errors: List[str] = []
+        if _sniff_stream_jsonl(path):
+            records: List[Any] = []
+            lines = open(path).read().split("\n")
+            nonempty = [
+                (i + 1, ln) for i, ln in enumerate(lines) if ln.strip()
+            ]
+            for pos, (lineno, line) in enumerate(nonempty):
+                try:
+                    records.append(_strict_loads(line))
+                except ValueError as e:
+                    if pos == len(nonempty) - 1:
+                        # a torn TAIL is the expected crash artifact —
+                        # adoption truncates it; the validator tolerates
+                        # it (the chain above it is still judged)
+                        continue
+                    errors.append(f"{path}:{lineno}: {e}")
+            errors += [
+                f"{path}: {e}"
+                for e in validate_metrics_stream(records, where="stream")
+            ]
+            return errors
         with open(path) as f:
             for lineno, line in enumerate(f, start=1):
                 if not line.strip():
                     continue
                 try:
-                    # strict: bare NaN/Infinity tokens must fail, exactly
-                    # as they would in jq / JSON.parse
-                    obj = json.loads(
-                        line, parse_constant=lambda c: (_ for _ in ()).throw(
-                            ValueError(f"non-strict JSON constant {c}")
-                        )
-                    )
+                    obj = _strict_loads(line)
                 except ValueError as e:
                     errors.append(f"{path}:{lineno}: {e}")
                     continue
@@ -1578,7 +1925,60 @@ def validate_file(path: str) -> List[str]:
     return [f"{path}: {e}" for e in errors]
 
 
+#: every schema surface this validator understands, newest first — what
+#: ``--schema`` prints so drivers/tests can pin the supported range
+#: without parsing the module
+SUPPORTED_SCHEMAS = (
+    "evox_tpu.run_report/v11 (validates v1-v11)",
+    "evox_tpu.metrics_stream/v1",
+    "bench summary (sub_metrics)",
+    "bench envelope (cmd+tail)",
+    "chrome trace (traceEvents)",
+)
+
+
+def detect_schema(path: str) -> str:
+    """Best-effort schema tag of one file (what validate_file would
+    dispatch it as) — the ``--schema`` per-file answer."""
+    try:
+        if path.endswith(".jsonl"):
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    obj = json.loads(line)
+                    if isinstance(obj, dict) and isinstance(
+                        obj.get("schema"), str
+                    ):
+                        return obj["schema"]
+                    return "unknown (.jsonl, first record has no schema)"
+            return "unknown (empty .jsonl)"
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        return f"unreadable ({e})"
+    if isinstance(obj, dict):
+        if "traceEvents" in obj:
+            return "chrome trace"
+        if "sub_metrics" in obj:
+            return "bench summary"
+        if "tail" in obj and "cmd" in obj:
+            return "bench envelope"
+        if isinstance(obj.get("schema"), str):
+            return obj["schema"]
+    return "unknown"
+
+
 def main(argv: List[str]) -> int:
+    if "--schema" in argv:
+        paths = [a for a in argv if a != "--schema"]
+        if not paths:
+            for s in SUPPORTED_SCHEMAS:
+                print(s)
+            return 0
+        for path in paths:
+            print(f"{path}: {detect_schema(path)}")
+        return 0
     if not argv:
         print(__doc__)
         return 2

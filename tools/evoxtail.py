@@ -1,0 +1,329 @@
+"""evoxtail — read-only CLI over a serving metrics stream.
+
+``FlightRecorder`` (evox_tpu/workflows/flightrec.py) appends the
+serving plane's life — registry samples, discrete events, pod barriers
+— to an fsynced hash-chained ``metrics.jsonl``. This tool is the
+operator's window onto that file while (or after) the service runs:
+
+Usage::
+
+    python tools/evoxtail.py RUN_DIR              # summary + SLO ledger
+    python tools/evoxtail.py RUN_DIR --tail 20    # newest 20 records
+    python tools/evoxtail.py RUN_DIR --replay     # every record, in order
+    python tools/evoxtail.py RUN_DIR --follow     # live: poll for appends
+    python tools/evoxtail.py RUN_DIR --prometheus # OpenMetrics exposition
+
+``RUN_DIR`` may be the stream directory or the ``metrics.jsonl`` path
+itself. STRICTLY READ-ONLY: a live driver owns the stream's chain and
+its torn-tail repair; this tool never opens the file for writing, never
+truncates, and treats an unparsable tail line as the expected crash
+artifact (skipped). Chain *verification* is check_report.py's job —
+tailing must keep working on a stream that is mid-append.
+
+Deliberately stdlib-only (the check_report.py discipline): the tool
+must run on a machine with no jax installed — a laptop tailing an
+rsync'd stream, a cron exporter — so it re-implements the few dozen
+lines of record parsing and OpenMetrics formatting instead of importing
+the package. The formats are pinned against the real implementations by
+tests/test_flightrec.py (byte-identical OpenMetrics exposition).
+
+Exit status: 0 on success, 1 when the stream file is missing/empty,
+2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+STREAM_FILENAME = "metrics.jsonl"
+STREAM_SCHEMA_PREFIX = "evox_tpu.metrics_stream/"
+
+
+# ------------------------------------------------------------------ reading
+
+
+def resolve_stream(path: str) -> str:
+    if os.path.isdir(path):
+        return os.path.join(path, STREAM_FILENAME)
+    return path
+
+
+def parse_line(line: bytes) -> Optional[dict]:
+    """One stream line -> record dict, or None for blank/torn lines."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None  # torn tail — the crash artifact, reader-safe
+    return rec if isinstance(rec, dict) else None
+
+
+def read_records(path: str) -> List[dict]:
+    records: List[dict] = []
+    with open(path, "rb") as f:
+        for line in f:
+            rec = parse_line(line)
+            if rec is not None:
+                records.append(rec)
+    return records
+
+
+def newest(records: List[dict], kind: str) -> Optional[dict]:
+    for rec in reversed(records):
+        if rec.get("kind") == kind:
+            return rec
+    return None
+
+
+# --------------------------------------------------------------- rendering
+
+
+def _fmt_num(v: Any) -> str:
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return str(v)
+
+
+def fmt_record(rec: dict) -> str:
+    kind = rec.get("kind", "?")
+    tm = rec.get("tm")
+    stamp = f"[{float(tm):10.3f}s]" if isinstance(tm, (int, float)) else "[         ?]"
+    if kind == "meta":
+        return (
+            f"{stamp} meta     process {rec.get('process_id')}/"
+            f"{rec.get('process_count')} pid_base={rec.get('pid_base')}"
+        )
+    if kind == "event":
+        extras = {
+            k: v
+            for k, v in rec.items()
+            if k not in ("schema", "seq", "kind", "t", "tm", "prev", "sha", "name")
+        }
+        body = " ".join(f"{k}={_fmt_num(v)}" for k, v in extras.items())
+        return f"{stamp} event    {rec.get('name')} {body}".rstrip()
+    if kind == "barrier":
+        return f"{stamp} barrier  {rec.get('name')}"
+    if kind == "sample":
+        slo = rec.get("slo") or {}
+        n_ctr = len(rec.get("counters") or {})
+        gen = rec.get("generation")
+        gen_s = f" gen={gen}" if gen is not None else ""
+        return (
+            f"{stamp} sample  {gen_s} counters={n_ctr} "
+            f"tenant_gens={slo.get('tenant_gens', 0)} "
+            f"rate={slo.get('tenant_gens_per_s', 0)}/s "
+            f"deadline={slo.get('deadline_hits', 0)}:"
+            f"{slo.get('deadline_misses', 0)}"
+        )
+    return f"{stamp} {kind}"
+
+
+def render_slo(slo: Dict[str, Any]) -> List[str]:
+    hits = int(slo.get("deadline_hits", 0))
+    misses = int(slo.get("deadline_misses", 0))
+    settled = hits + misses
+    hit_rate = f"{hits / settled:.1%}" if settled else "n/a"
+    return [
+        "SLO ledger",
+        f"  tenant generations  {slo.get('tenant_gens', 0)}"
+        f"  ({slo.get('tenant_gens_per_s', 0)}/s over"
+        f" {slo.get('elapsed_s', 0)}s)",
+        f"  admissions          {slo.get('admissions', 0)}",
+        f"  preemptions         {slo.get('preemptions', 0)}",
+        f"  deadlines           {hits} hit / {misses} missed"
+        f"  (hit rate {hit_rate})",
+    ]
+
+
+def render_summary(records: List[dict], path: str) -> List[str]:
+    lines = [f"stream: {path}"]
+    meta = newest(records, "meta")
+    if meta is not None:
+        lines.append(
+            f"process {meta.get('process_id')}/{meta.get('process_count')}"
+            f", started_wall={meta.get('started_wall')}"
+        )
+    counts: Dict[str, int] = {}
+    for rec in records:
+        k = str(rec.get("kind"))
+        counts[k] = counts.get(k, 0) + 1
+    lines.append(
+        "records: "
+        + ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+    )
+    sample = newest(records, "sample")
+    if sample is None:
+        lines.append("no samples yet — SLO ledger unavailable")
+    else:
+        lines.append("")
+        lines.extend(render_slo(sample.get("slo") or {}))
+        counters = sample.get("counters") or {}
+        if counters:
+            lines.append("")
+            lines.append("top counters (newest sample)")
+            top = sorted(counters.items(), key=lambda kv: -float(kv[1]))[:12]
+            width = max(len(name) for name, _ in top)
+            for name, v in top:
+                lines.append(f"  {name:<{width}}  {_fmt_num(v)}")
+        gauges = sample.get("gauges") or {}
+        if gauges:
+            lines.append("")
+            lines.append("gauges (newest sample)")
+            width = max(len(name) for name in gauges)
+            for name, v in sorted(gauges.items()):
+                lines.append(f"  {name:<{width}}  {_fmt_num(v)}")
+    events = [r for r in records if r.get("kind") in ("event", "barrier")]
+    if events:
+        lines.append("")
+        lines.append("recent events")
+        lines.extend(f"  {fmt_record(r)}" for r in events[-10:])
+    return lines
+
+
+# ------------------------------------------------------------- prometheus
+
+
+def _prom_name(name: str) -> str:
+    return "".join(
+        c if (c.isalnum() or c == "_") else "_" for c in name
+    ).strip("_")
+
+
+def _prom_num(v: Any) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def to_openmetrics(sample: dict) -> str:
+    """OpenMetrics exposition of a stream ``sample`` record — the exact
+    text ``MetricsRegistry.to_openmetrics`` would produce from the same
+    state (pinned equal by tests/test_metrics.py), rebuilt here from the
+    snapshot so scraping an rsync'd stream needs no package import."""
+    lines: List[str] = []
+    for name, v in sorted((sample.get("counters") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn}_total {_prom_num(v)}")
+    for name, v in sorted((sample.get("gauges") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_prom_num(v)}")
+    for name, h in sorted((sample.get("histograms") or {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        for le, c in zip(h["le"], h["counts"]):
+            lines.append(f'{pn}_bucket{{le="{_prom_num(le)}"}} {c}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {_prom_num(h['sum'])}")
+        lines.append(f"{pn}_count {h['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------- follow
+
+
+def follow(path: str, interval_s: float = 0.5, out=sys.stdout) -> None:
+    """tail -f: print records already present, then poll for appends.
+    Only COMPLETE lines are emitted — a partial line (an append caught
+    mid-write, or the torn tail of a crash) stays buffered until its
+    newline lands, so a record is never printed twice or half."""
+    pos = 0
+    buf = b""
+    while True:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            size = 0
+        if size < pos:  # rotated/truncated (a fresh adoption) — restart
+            pos, buf = 0, b""
+        if size > pos:
+            with open(path, "rb") as f:
+                f.seek(pos)
+                buf += f.read()
+                pos = f.tell()
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                rec = parse_line(line)
+                if rec is not None:
+                    print(fmt_record(rec), file=out, flush=True)
+        time.sleep(interval_s)
+
+
+# ------------------------------------------------------------------- main
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="evoxtail", description=__doc__.split("\n\n")[0]
+    )
+    ap.add_argument("stream", help="stream directory or metrics.jsonl path")
+    ap.add_argument("--tail", type=int, metavar="N", help="newest N records")
+    ap.add_argument(
+        "--replay", action="store_true", help="every record from the start"
+    )
+    ap.add_argument(
+        "--follow", action="store_true", help="poll the file for new records"
+    )
+    ap.add_argument(
+        "--prometheus",
+        action="store_true",
+        help="OpenMetrics exposition of the newest sample",
+    )
+    ap.add_argument(
+        "--interval",
+        type=float,
+        default=0.5,
+        metavar="S",
+        help="--follow poll interval in seconds (default 0.5)",
+    )
+    args = ap.parse_args(argv)
+    path = resolve_stream(args.stream)
+    if args.follow:
+        try:
+            follow(path, interval_s=args.interval)
+        except KeyboardInterrupt:
+            return 0
+    if not os.path.exists(path):
+        print(f"evoxtail: no stream at {path}", file=sys.stderr)
+        return 1
+    records = read_records(path)
+    if not records:
+        print(f"evoxtail: {path} has no records", file=sys.stderr)
+        return 1
+    first_schema = str(records[0].get("schema", ""))
+    if not first_schema.startswith(STREAM_SCHEMA_PREFIX):
+        print(
+            f"evoxtail: {path} does not look like a metrics stream "
+            f"(first record schema {first_schema!r})",
+            file=sys.stderr,
+        )
+        return 1
+    if args.prometheus:
+        sample = newest(records, "sample")
+        if sample is None:
+            print(f"evoxtail: {path} has no sample records", file=sys.stderr)
+            return 1
+        sys.stdout.write(to_openmetrics(sample))
+        return 0
+    if args.replay:
+        for rec in records:
+            print(fmt_record(rec))
+        return 0
+    if args.tail is not None:
+        for rec in records[-args.tail:]:
+            print(fmt_record(rec))
+        return 0
+    print("\n".join(render_summary(records, path)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
