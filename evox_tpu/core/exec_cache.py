@@ -58,20 +58,27 @@ Portability caveats (jax 0.4.x, non-TPU backends):
   off-TPU (warned; the entry amortizes in memory only). Custom-call-free
   algorithms (PSO, OpenES, SepCMAES) persist and cold-start fine.
 - A DESERIALIZED executable still referenced at interpreter exit can
-  segfault jax's atexit ``clear_backends`` — after the process result is
-  durable, drop cache/workflow references (or use ``os._exit``) before
-  teardown; tests/test_elastic.py's fresh-process child shows the
-  pattern. Executables compiled in-process are unaffected.
+  segfault jax's atexit ``clear_backends`` — every cache therefore
+  registers itself with a module-level atexit guard that calls
+  :meth:`ExecutableCache.close` (drop the in-memory executable refs)
+  before jax's teardown runs, so a process exiting with cache hits no
+  longer needs ``os._exit`` or manual reference surgery (PR 18; the
+  guard is armed at first construction, AFTER jax registered its own
+  handler, so atexit's LIFO order runs ours first). ``close()`` is
+  also callable directly for deterministic teardown. Executables
+  compiled in-process are unaffected either way.
 """
 
 from __future__ import annotations
 
+import atexit
 import hashlib
 import json
 import os
 import pickle
 import time
 import warnings
+import weakref
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -88,6 +95,31 @@ __all__ = [
 ]
 
 _SCHEMA = "evox_tpu.exec_cache/v1"
+
+# every live cache, so the atexit guard can drop deserialized-executable
+# references before jax's clear_backends runs (PERF_NOTES §23: such a
+# reference surviving to interpreter teardown can segfault). WeakSet: the
+# guard must never be what keeps a cache alive.
+_LIVE_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+_GUARD_ARMED = False
+
+
+def _close_live_caches() -> None:
+    for cache in list(_LIVE_CACHES):
+        try:
+            cache.close()
+        except Exception:
+            pass  # teardown must never raise over other atexit handlers
+
+
+def _arm_teardown_guard() -> None:
+    global _GUARD_ARMED
+    if not _GUARD_ARMED:
+        # registered lazily at FIRST cache construction — necessarily
+        # after `import jax` registered clear_backends, so LIFO atexit
+        # ordering runs this guard before jax tears the backend down
+        atexit.register(_close_live_caches)
+        _GUARD_ARMED = True
 
 
 class ExecCacheError(RuntimeError):
@@ -221,6 +253,17 @@ class ExecutableCache:
         # mirror hit/miss/compile-ms into the live metrics plane; None
         # (default) changes nothing
         self.metrics: Any = None
+        _LIVE_CACHES.add(self)
+        _arm_teardown_guard()
+
+    def close(self) -> None:
+        """Drop every in-memory executable reference (PERF_NOTES §23:
+        a DESERIALIZED executable alive at interpreter exit can
+        segfault jax's atexit teardown). Durable state — the on-disk
+        store, counters, provenance — is untouched, and the cache stays
+        usable: a later request simply pays a disk hit (or a recompile)
+        again. Idempotent; also run by the module's atexit guard."""
+        self._mem.clear()
 
     # -------------------------------------------------------------- keying
     @staticmethod

@@ -759,6 +759,31 @@ class GenerationExecutor:
                 self.queue_stats["io_inflight_max"], lane.high_water
             )
 
+    def close(self) -> None:
+        """Quiesce the executor: drain every named background lane (so a
+        failed fsync still surfaces), then shut their worker threads
+        down and forget them. A lane thread alive at interpreter exit
+        races the jax atexit backend teardown the same way a live
+        deserialized executable does (PERF_NOTES §23) — pod drains and
+        the multi-pod gateway call this before letting the process exit.
+        Idempotent; a closed executor lazily re-creates lanes if used
+        again."""
+        lanes = getattr(self, "_named_lanes", None) or {}
+        first_err: Optional[BaseException] = None
+        for name in list(lanes):
+            try:
+                self.drain_lane(name)
+            except Exception as e:  # keep closing the rest
+                if first_err is None:
+                    first_err = e
+            lanes[name].close()
+        self._named_lanes = {}
+        active = getattr(self, "_active_ckpt_lanes", None)
+        if active is not None:
+            active.clear()
+        if first_err is not None:
+            raise first_err
+
     def _drain_checkpoint_lanes(self) -> None:
         for lane in list(getattr(self, "_active_ckpt_lanes", [])):
             try:

@@ -622,6 +622,7 @@ def run_report(
     executor: Any = None,
     pod_supervisor: Any = None,
     metrics: Any = None,
+    control_plane: Any = None,
 ) -> dict:
     """Merge device telemetry and host dispatch timings into ONE
     JSON-serializable dict.
@@ -684,10 +685,15 @@ def run_report(
     # `slo` sections (workflows/flightrec.py FlightRecorder: the
     # serving-plane registry snapshot, stream accounting, and the SLO
     # ledger) — validated when present, incl. slo↔tenancy.queue
-    # counter coherence.
+    # counter coherence. v12 adds the optional `control_plane` section
+    # (ISSUE 18, workflows/control_plane.py: the multi-pod gateway's pod
+    # census, ledger event counts, tenant accounting with the
+    # exactly-once admission audit, and the steal/autoscale event
+    # streams) — validated when present, incl. the ledger↔counter
+    # coherence and empty-duplicate-admissions rules.
     report: dict = {
-        "schema": "evox_tpu.run_report/v11",
-        "schema_version": 11,
+        "schema": "evox_tpu.run_report/v12",
+        "schema_version": 12,
     }
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
@@ -841,6 +847,16 @@ def run_report(
         report["metrics"] = metrics.report()
         if hasattr(metrics, "slo_ledger"):
             report["slo"] = metrics.slo_ledger()
+    # multi-pod control plane (schema v12, workflows/control_plane.py):
+    # a workflow served through the gateway advertises it as
+    # `_control_plane` (duck-typed like every pickup above — core never
+    # imports the workflows package); its report() — pod census, ledger
+    # event counts, exactly-once admission audit, steal/autoscale
+    # streams — becomes the `control_plane` section
+    if control_plane is None and workflow is not None:
+        control_plane = getattr(workflow, "_control_plane", None)
+    if control_plane is not None and hasattr(control_plane, "report"):
+        report["control_plane"] = control_plane.report()
     if extra:
         report["extra"] = dict(extra)
     return sanitize_json(report)

@@ -229,6 +229,19 @@ class MetricsRegistry:
         m = self.get(name)
         return default if m is None or isinstance(m, Histogram) else m.snapshot()
 
+    def values(self, prefix: str) -> Dict[str, float]:
+        """Every counter/gauge scalar under a dotted-name prefix, e.g.
+        ``values("control.")`` -> the multi-pod gateway's own family.
+        Histograms are skipped (their snapshot is a dict, not a scalar);
+        consumers wanting them take the full :meth:`snapshot`."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {
+            m.name: m.snapshot()
+            for m in metrics
+            if m.name.startswith(prefix) and not isinstance(m, Histogram)
+        }
+
     # -------------------------------------------------------------- snapshot
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
         """The registry as one strict-JSON dict, kinds separated so a

@@ -81,6 +81,7 @@ from __future__ import annotations
 import signal
 import threading
 import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .distributed import (
@@ -96,10 +97,21 @@ __all__ = [
     "COORDINATOR_LOSS",
     "POD_FAILURE_CLASSES",
     "POD_EVENT_KINDS",
+    "JAXLIB_COORD_ABORT_S",
     "CollectiveDeadlineError",
     "PodFailureError",
     "PodSupervisor",
 ]
+
+#: jaxlib's coordination client aborts the PROCESS (C++ LOG(FATAL) →
+#: SIGABRT) roughly this many seconds after it stops reaching the
+#: coordinator (PERF_NOTES §25) — the hard ceiling a supervisor deadline
+#: must undercut in a real multi-process pod to classify the failure
+#: before the runtime kills the classifier
+JAXLIB_COORD_ABORT_S = 10.0
+#: safety margin under the abort ceiling (the ~10 s figure is empirical,
+#: not an API contract)
+_COORD_ABORT_MARGIN_S = 0.5
 
 # pod-domain failure classes (strings so post-mortems stay plain JSON) —
 # the cross-process refinement of the PR-5 transient/oom/deadline/fatal
@@ -307,6 +319,35 @@ class PodSupervisor:
             self.process_id, self.process_count = _dist_process_info()
         except Exception:  # pragma: no cover - backend not initializable
             self.process_id, self.process_count = 0, 1
+        # PERF_NOTES §25: in a REAL multi-process pod, jaxlib's own
+        # coordination client LOG(FATAL)s the process ~10 s after it
+        # stops reaching the coordinator — a supervisor deadline whose
+        # worst-case detection latency (deadline + census probe slack)
+        # cannot beat that race yields SIGABRT post-mortems instead of
+        # classified failures. Validate at construction: clamp + warn
+        # rather than silently losing the race. Single-process
+        # supervisors (tests, local watchdogs) have no coordination
+        # client, so any deadline is fine there.
+        if (
+            self.deadline_s is not None
+            and self.process_count > 1
+        ):
+            slack = 2.0 * self.heartbeat_interval_s + 0.2  # census probe
+            budget = JAXLIB_COORD_ABORT_S - _COORD_ABORT_MARGIN_S - slack
+            if self.deadline_s > budget:
+                clamped = max(budget, self.heartbeat_interval_s)
+                warnings.warn(
+                    f"PodSupervisor deadline_s={self.deadline_s} cannot "
+                    f"win the race against jaxlib's ~{JAXLIB_COORD_ABORT_S:g} s "
+                    "coordination heartbeat abort (PERF_NOTES §25): "
+                    f"detection needs deadline + {slack:.1f} s census "
+                    f"slack; clamping to {clamped:.2f} s so pod faults "
+                    "are classified instead of dying by SIGABRT",
+                    stacklevel=2,
+                )
+                self.deadline_s = clamped
+                if checkpoint_deadline_s is None:
+                    self.checkpoint_deadline_s = 6.0 * clamped
         self._journal = self._resolve_journal(journal)
         self._hb_seq = 0
         self._hb_stop = threading.Event()

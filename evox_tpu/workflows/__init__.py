@@ -39,6 +39,11 @@ from .supervisor import (
     RunSupervisor,
     classify_error,
 )
+from .control_plane import (
+    ControlLedger,
+    ControlPlane,
+    PodAutoscaler,
+)
 
 __all__ = [
     "StdWorkflow",
@@ -79,4 +84,7 @@ __all__ = [
     "RunAbortedError",
     "DispatchDeadlineError",
     "classify_error",
+    "ControlLedger",
+    "ControlPlane",
+    "PodAutoscaler",
 ]

@@ -461,6 +461,35 @@ class WorkflowCheckpointer:
                     pass
 
 
+def snapshot_dir_intact(directory: Any) -> bool:
+    """Host-only intactness probe: does ``directory`` hold at least one
+    COMMITTED, UNTORN snapshot — manifest present, payload bytes and
+    SHA-256 matching — without unpickling anything? The multi-pod
+    control plane uses this before stealing a parked continuation off a
+    dead pod: a continuation whose checkpoint is torn cannot be re-
+    placed (the target would crash at admission), so it is re-run fresh
+    instead. Pure file I/O — safe from the gateway process with no jax
+    state, and axon-safe."""
+    directory = Path(directory)
+    tail = len(".manifest.json")
+    manifests = sorted(
+        directory.glob("ckpt_????????.pkl.manifest.json"), reverse=True
+    )
+    for mpath in manifests:
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            payload = (mpath.parent / mpath.name[:-tail]).read_bytes()
+            if len(payload) != manifest["bytes"]:
+                continue
+            if hashlib.sha256(payload).hexdigest() != manifest["sha256"]:
+                continue
+            return True
+        except Exception:
+            continue
+    return False
+
+
 def _as_checkpointer(resume_from: Any) -> WorkflowCheckpointer:
     if isinstance(resume_from, WorkflowCheckpointer):
         return resume_from

@@ -621,6 +621,7 @@ class ElasticServer:
         supervisor: Any = None,
         strict_after_warm: bool = False,
         metrics: Any = None,
+        executor: Any = None,
     ):
         self.factory = factory
         self.table = table if table is not None else BucketTable()
@@ -636,6 +637,11 @@ class ElasticServer:
         self.autoscaler = autoscaler
         self.supervisor = supervisor
         self.strict_after_warm = strict_after_warm
+        # one GenerationExecutor can serve every bucket queue (the
+        # background lanes are per-instance, so sharing keeps ONE
+        # checkpoint lane for the whole server); None lets each RunQueue
+        # build its own, as before
+        self.executor = executor
         # serving-plane flight recorder (PR 16): ONE recorder spans the
         # whole lattice — threaded into every bucket RunQueue (whose
         # samples then share one SLO ledger across buckets) and the
@@ -656,7 +662,9 @@ class ElasticServer:
     def bucket_for(self, spec: ElasticSpec) -> BucketShape:
         return self.table.bucket_for(spec.pop, spec.dim, self.width)
 
-    def _get_bucket(self, shape: BucketShape) -> _Bucket:
+    def _get_bucket(
+        self, shape: BucketShape, recover: bool = False
+    ) -> _Bucket:
         b = self._buckets.get(shape.key)
         if b is not None:
             return b
@@ -689,22 +697,42 @@ class ElasticServer:
             )
         warm_fleet_cache(wf, self.cache, bucket=shape, planned=True)
         wf._bucket_table = self.table  # run_report serving pickup
-        q = RunQueue(
-            wf,
-            chunk=self.chunk,
-            supervisor=self.supervisor,
-            journal=(
-                str(self.journal_dir / shape.key)
-                if self.journal_dir is not None
-                else None
-            ),
-            checkpoint_dir=(
-                str(self.checkpoint_dir / shape.key)
-                if self.checkpoint_dir is not None
-                else None
-            ),
-            metrics=self.metrics,
-        )
+        if recover:
+            # graft a journal-recovered queue in place of a fresh one:
+            # same factory/warm/validation path, but the queue's
+            # pending/slots/results come back from the bucket's journal
+            # (RunQueue.recover — the PR-11 replay law). The multi-pod
+            # control plane rebuilds dead or killed pods through this.
+            if self.journal_dir is None:
+                raise ValueError(
+                    "recovering a bucket needs journal_dir — there is "
+                    "no journal to replay without one"
+                )
+            q = RunQueue.recover(
+                wf,
+                str(self.journal_dir / shape.key),
+                supervisor=self.supervisor,
+                metrics=self.metrics,
+                executor=self.executor,
+            )
+        else:
+            q = RunQueue(
+                wf,
+                chunk=self.chunk,
+                supervisor=self.supervisor,
+                journal=(
+                    str(self.journal_dir / shape.key)
+                    if self.journal_dir is not None
+                    else None
+                ),
+                checkpoint_dir=(
+                    str(self.checkpoint_dir / shape.key)
+                    if self.checkpoint_dir is not None
+                    else None
+                ),
+                metrics=self.metrics,
+                executor=self.executor,
+            )
         b = _Bucket(shape=shape, workflow=wf, queue=q)
         self._buckets[shape.key] = b
         if self.strict_after_warm:
@@ -777,25 +805,56 @@ class ElasticServer:
                 return True
         return False
 
+    def has_work(self) -> bool:
+        """Public face of the scheduling loop's continue condition —
+        the multi-pod control plane polls it per pod."""
+        return self._has_work()
+
+    def serve_round(self) -> None:
+        """ONE scheduling quantum: every bucket with work advances one
+        chunk, then the autoscale pass runs. ``serve()`` is this in a
+        loop; the multi-pod control plane calls it directly so the
+        gateway can interleave rounds across pods (and kill/steal/
+        recover between them at chunk granularity)."""
+        for b in list(self._buckets.values()):
+            self._ensure_started(b)
+            q = b.queue
+            if q.state is None:
+                continue
+            if q.finished and not (q.pending or q.continuations):
+                continue
+            q.step_chunk()
+        self._autoscale_pass()
+
     def serve(self, max_rounds: Optional[int] = None) -> List[dict]:
         """Drive every bucket to completion (round-robin, one chunk per
         bucket per round; autoscale decisions between rounds). Returns
         the merged real-tenant results."""
         rounds = 0
         while self._has_work():
-            for b in list(self._buckets.values()):
-                self._ensure_started(b)
-                q = b.queue
-                if q.state is None:
-                    continue
-                if q.finished and not (q.pending or q.continuations):
-                    continue
-                q.step_chunk()
-            self._autoscale_pass()
+            self.serve_round()
             rounds += 1
             if max_rounds is not None and rounds >= max_rounds:
                 break
         return self.results()
+
+    # ------------------------------------------------------------- recover
+    def recover_bucket(self, shape: BucketShape) -> "_Bucket":
+        """Rebuild one bucket from its journal: the factory re-creates
+        the workflow (same validation + cache warm as a fresh bucket),
+        then :meth:`RunQueue.recover` replays the bucket's journal to
+        the newest intact barrier. Driving the server afterwards
+        completes the sweep with per-tenant results identical to the
+        uncrashed run — the PR-11 law, lifted to the lattice. Raises if
+        the bucket is already live (recovery is for dead processes, not
+        running ones)."""
+        if shape.key in self._buckets:
+            raise RuntimeError(
+                f"bucket {shape.key} is already live in this server — "
+                "recover_bucket rebuilds dead buckets, it cannot replace "
+                "a running queue"
+            )
+        return self._get_bucket(shape, recover=True)
 
     # ----------------------------------------------------------- autoscale
     def _autoscale_pass(self) -> None:

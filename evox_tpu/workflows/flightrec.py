@@ -94,6 +94,9 @@ class MetricsStream(ChainedLog):
     FILENAME = "metrics.jsonl"
     SCHEMA = STREAM_SCHEMA
     KINDS = STREAM_KINDS
+    # retention may drop old segments but never the newest rendezvous
+    # anchor — pod merge alignment needs at least one intact barrier
+    PIN_KINDS = ("barrier",)
 
     def report(self) -> dict:
         """The ``metrics.stream`` subsection of ``run_report()``."""
@@ -119,6 +122,13 @@ class FlightRecorder:
             ``meta`` record and the pid mapping; default auto-detects
             via :func:`~evox_tpu.core.distributed._dist_process_info`
             so a plain single-process recorder needs no arguments.
+        max_segment_bytes / retain_segments: forwarded to
+            :class:`MetricsStream` — size-bounded segment rotation of
+            ``metrics.jsonl`` with the hash chain carried across the
+            boundary, and opt-in retention that never drops the newest
+            intact ``barrier`` (see :class:`~evox_tpu.workflows.journal.
+            ChainedLog`). A long-lived serving process SHOULD set these;
+            the defaults keep one unbounded file (the PR-16 behavior).
 
     Producers call :meth:`count` / :meth:`set` / :meth:`observe`
     (registry mutations — pure host memory, safe at any frequency),
@@ -136,6 +146,8 @@ class FlightRecorder:
         ring_capacity: int = 256,
         process_id: Optional[int] = None,
         process_count: Optional[int] = None,
+        max_segment_bytes: Optional[int] = None,
+        retain_segments: Optional[int] = None,
     ):
         if ring_capacity < 1:
             raise ValueError(f"ring_capacity must be >= 1, got {ring_capacity}")
@@ -156,7 +168,11 @@ class FlightRecorder:
         self._started_wall = time.time()
         self.stream: Optional[MetricsStream] = None
         if directory is not None:
-            self.stream = MetricsStream(str(directory))
+            self.stream = MetricsStream(
+                str(directory),
+                max_segment_bytes=max_segment_bytes,
+                retain_segments=retain_segments,
+            )
             if not self.stream.records(kind="meta"):
                 self.stream.append(
                     "meta",

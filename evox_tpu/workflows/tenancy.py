@@ -1276,6 +1276,68 @@ class RunQueue:
             }
         )
 
+    def release_continuation(self, seq: int) -> dict:
+        """Release QUEUED work — a parked continuation, or a still-
+        pending spec — because it was stolen: the multi-pod control
+        plane (:mod:`~evox_tpu.workflows.control_plane`) re-placed it on
+        another pod, where its submit is already durable. Same WAL
+        ordering as the elastic-growth handoff: the caller makes the
+        work durable in the TARGET journal first, then releases it
+        here — a crash between the two leaves a duplicate (healed by
+        the control plane's checkpoint/tag dedup at recovery), never a
+        loss. The journal records a ``steal`` so recovery of THIS queue
+        never requeues the moved seq. Returns a descriptor of the
+        released work ({seq, tag, checkpoint, done}). Raises
+        ``KeyError`` when no queued work carries ``seq`` — an ACTIVE
+        slot cannot be stolen directly (preempt it first; the
+        preemption parks a continuation)."""
+        seq = int(seq)
+        for i, c in enumerate(self.continuations):
+            if c.get("seq") is not None and int(c["seq"]) == seq:
+                if self.journal is not None and c.get("checkpoint") is None:
+                    raise ValueError(
+                        "a journaled queue cannot release an in-memory "
+                        "continuation — nothing durable exists for the "
+                        "target pod to resume from"
+                    )
+                self.continuations.pop(i)
+                desc = {
+                    "seq": seq,
+                    "tag": c["spec"].tag,
+                    "checkpoint": c.get("checkpoint"),
+                    "done": c.get("done"),
+                }
+                break
+        else:
+            for i, spec in enumerate(self.pending):
+                if getattr(spec, "_journal_seq", None) == seq:
+                    self.pending.pop(i)
+                    desc = {
+                        "seq": seq,
+                        "tag": spec.tag,
+                        "checkpoint": None,
+                        "done": None,
+                    }
+                    break
+            else:
+                raise KeyError(
+                    f"no queued work (continuation or pending spec) "
+                    f"carries journal seq {seq}"
+                )
+        self.counters["stolen"] = self.counters.get("stolen", 0) + 1
+        if self.journal is not None:
+            self.journal.append(
+                "steal",
+                spec_seq=seq,
+                tag=desc["tag"],
+                checkpoint=desc["checkpoint"],
+            )
+        if self.metrics is not None:
+            self.metrics.event(
+                "queue.stolen", tag=desc["tag"], seq=seq
+            )
+        return desc
+
     def start(self) -> VectorizedWorkflowState:
         """Fill every slot and init the fleet. Slots draw from pending
         specs AND parked continuations under the ``_refill`` priority
@@ -2123,12 +2185,25 @@ class RunQueue:
                 for r in recs
                 if r["kind"] in ("preempt", "autoscale")
             }
+            # a stolen seq is already durable in ANOTHER pod's journal
+            # (the steal record is appended only after the target submit
+            # fsynced) — requeueing it here would run the tenant twice,
+            # once per pod
+            stolen = {
+                int(r["spec_seq"])
+                for r in recs
+                if r["kind"] == "steal" and r.get("spec_seq") is not None
+            }
             q.pending = [
-                specs[s] for s in sorted(specs) if s not in resume_from
+                specs[s]
+                for s in sorted(specs)
+                if s not in resume_from and s not in stolen
             ]
             q.continuations = []
             seen_ckpts: set = set()
             for s in sorted(specs):
+                if s in stolen:
+                    continue
                 if s not in resume_from or resume_from[s] in derived:
                     continue
                 if resume_from[s] in seen_ckpts:
@@ -2221,7 +2296,20 @@ class RunQueue:
         ):
             state = workflow.with_freeze_mask(state)
         q.state = state
-        q.pending = [specs[s] for s in meta["pending"]]
+        # a steal record (pre- OR post-barrier) marks work that is
+        # already durable in another pod's journal — the WAL order
+        # (target submit fsynced before the steal is appended here)
+        # makes honoring EVERY steal safe: the barrier may predate the
+        # steal, but the moved work must not be restored into this
+        # queue or it runs twice, once per pod
+        stolen = {
+            int(r["spec_seq"])
+            for r in recs
+            if r["kind"] == "steal" and r.get("spec_seq") is not None
+        }
+        q.pending = [
+            specs[s] for s in meta["pending"] if int(s) not in stolen
+        ]
         q.continuations = [
             {
                 "spec": specs[int(c["seq"])],
@@ -2233,6 +2321,7 @@ class RunQueue:
                 ),
             }
             for c in meta.get("continuations", []) or []
+            if int(c["seq"]) not in stolen
         ]
         q.slots = [
             None
@@ -2312,7 +2401,7 @@ class RunQueue:
             resume_from[s] for s in accounted if s in resume_from
         }
         for seq in sorted(specs):
-            if seq in accounted:
+            if seq in accounted or seq in stolen:
                 continue
             if seq in resume_from:
                 ck = resume_from[seq]

@@ -1,0 +1,201 @@
+"""Multi-pod control-plane chaos harness (ISSUE 18).
+
+tests/_proc_chaos.py kills ONE queue driver; this module kills the
+GATEWAY of a multi-pod plane — the process that owns the control ledger
+and every pod's in-memory server — at scripted points of a seeded churn
+trace, and (separately) a pod driver running as its own OS process
+(tools/_multihost_worker.py control-pod mode). The parent then runs
+``ControlPlane.recover`` over the directory and drives the sweep to
+completion; tests/test_control_plane.py asserts the kill-anywhere law:
+per-tenant completed results (tags, generations, telemetry
+fingerprints) equal the uncrashed run's, each spec admitted exactly
+once.
+
+Kill points:
+
+- ``kill_after_rounds=K`` — SIGKILL immediately after gateway round K
+  (a chunk boundary on every pod: the only places gateway state moves).
+- ``kill_point=(prefix, nth)`` — SIGKILL at the nth crash-hook point
+  matching ``prefix`` (``pre_place:``/``pre_pod_submit:`` split the
+  admission WAL; ``steal_target_durable:``/``pre_source_release:``
+  split the steal WAL — the mid-handoff kill).
+- ``dead_pod``/``dead_after_rounds`` — the child itself declares a pod
+  dead mid-trace (the pod-death + gateway-death combination).
+
+The churn trace is deadline-FREE by construction: a stolen tenant's
+deadline would be re-based against a different pod's fleet clock, which
+could flip a hit/miss vs the uncrashed twin — the digest law needs the
+trace itself to be placement-independent. (Deadlined specs are covered
+by the continuation-steal law in test_control_plane.py, which compares
+two runs with IDENTICAL pre-death choreography.)
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+import sys
+from typing import List, Optional, Tuple
+
+N_PODS = 2
+WIDTH = 2
+CHUNK = 3
+DIM, POP = 4, 8
+#: tier-1 churn size (O(10^2) acknowledged tenants); the slow-marked
+#: matrix passes its own larger count
+N_TENANTS_T1 = 100
+
+
+def make_factory(shape):
+    """The canonical bucket factory — module-level so the control-pod
+    subprocess flavor can import it as ``_control_chaos:make_factory``."""
+    import jax.numpy as jnp
+
+    from evox_tpu.algorithms.so.es import CMAES
+    from evox_tpu.monitors import TelemetryMonitor
+    from evox_tpu.problems.numerical import Sphere
+    from evox_tpu.workflows.elastic import ACTIVE_ROWS, ElasticWorkflow
+
+    algo = CMAES(
+        center_init=jnp.ones(shape.dim), init_stdev=1.0, pop_size=shape.pop
+    )
+    return ElasticWorkflow(
+        algo,
+        Sphere(),
+        n_tenants=shape.width,
+        hyperparams={
+            ACTIVE_ROWS: jnp.full((shape.width,), shape.pop, jnp.int32)
+        },
+        monitors=(TelemetryMonitor(capacity=8),),
+    )
+
+
+def churn_specs(n: int = N_TENANTS_T1) -> list:
+    """The seeded churn trace: n deadline-free tenants, varying budgets,
+    all in one bucket (pop/dim fixed — cross-bucket routing has its own
+    tier in test_elastic.py; this harness stresses cross-POD movement)."""
+    from evox_tpu.workflows.elastic import ElasticSpec
+
+    return [
+        ElasticSpec(
+            seed=1000 + i,
+            n_steps=5 + i % 4,
+            pop=POP,
+            dim=DIM,
+            tag=f"cp{i:04d}",
+        )
+        for i in range(n)
+    ]
+
+
+def build_plane(root, n_pods: int = N_PODS, **kw):
+    from evox_tpu.workflows.control_plane import ControlPlane
+
+    return ControlPlane(
+        make_factory, str(root), n_pods=n_pods, width=WIDTH, chunk=CHUNK, **kw
+    )
+
+
+def recover_plane(root, **kw):
+    from evox_tpu.workflows.control_plane import ControlPlane
+
+    return ControlPlane.recover(
+        make_factory, str(root), width=WIDTH, chunk=CHUNK, **kw
+    )
+
+
+def result_digest(results: List[dict]) -> List[tuple]:
+    """The kill-anywhere comparison key: COMPLETED entries only (tag,
+    generations, telemetry ring fingerprint), sorted by tag — placement
+    annotations (pod/bucket) are excluded on purpose: the law is that
+    results are placement-independent."""
+    return sorted(
+        (
+            r["tag"],
+            r["generations"],
+            tuple(r.get("fingerprints") or ()),
+        )
+        for r in results
+        if r["status"] == "completed"
+    )
+
+
+def _arm_kill_point(prefix: str, nth: int) -> None:
+    from evox_tpu.workflows import control_plane as cp
+
+    seen = {"n": 0}
+
+    def hook(point: str) -> None:
+        if point.startswith(prefix):
+            seen["n"] += 1
+            if seen["n"] >= nth:
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    cp._CRASH_HOOK = hook
+
+
+def gateway_main(
+    root: str,
+    n_tenants: int,
+    kill_after_rounds: Optional[int] = None,
+    kill_point: Optional[Tuple[str, int]] = None,
+    dead_pod: Optional[str] = None,
+    dead_after_rounds: Optional[int] = None,
+) -> None:
+    """Child entry point: run the gateway over the churn trace, die on
+    schedule. Exits 0 on clean completion with no kill configured, 7
+    when a configured kill never fired (a harness bug, not a pass)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if kill_point is not None:
+        _arm_kill_point(*kill_point)
+    plane = build_plane(root)
+    for s in churn_specs(n_tenants):
+        plane.submit(s)
+    rounds = 0
+    while plane.has_work():
+        plane.serve_round()
+        rounds += 1
+        if (
+            dead_pod is not None
+            and dead_after_rounds is not None
+            and rounds == dead_after_rounds
+        ):
+            plane.mark_dead(dead_pod, reason="chaos")
+        if kill_after_rounds is not None and rounds >= kill_after_rounds:
+            os.kill(os.getpid(), signal.SIGKILL)
+    armed = kill_after_rounds is not None or kill_point is not None
+    sys.exit(7 if armed else 0)
+
+
+def run_gateway(
+    root,
+    n_tenants: int,
+    kill_after_rounds: Optional[int] = None,
+    kill_point: Optional[Tuple[str, int]] = None,
+    dead_pod: Optional[str] = None,
+    dead_after_rounds: Optional[int] = None,
+    timeout: float = 600.0,
+) -> int:
+    """Spawn the gateway child; returns its exit code (-SIGKILL when
+    the scripted kill fired)."""
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(
+        target=gateway_main,
+        args=(
+            str(root),
+            n_tenants,
+            kill_after_rounds,
+            kill_point,
+            dead_pod,
+            dead_after_rounds,
+        ),
+        daemon=True,
+    )
+    p.start()
+    p.join(timeout)
+    if p.is_alive():
+        p.kill()
+        p.join()
+        raise RuntimeError("control-plane gateway child hung past timeout")
+    return p.exitcode

@@ -159,12 +159,16 @@ def driver_main(
 # compute. No jax work: FlightRecorder is pure host-side file I/O.
 
 
-def metrics_child_main(stream_dir: str) -> None:
+def metrics_child_main(stream_dir: str, max_segment_bytes=None) -> None:
     """Child entry point: append count/event/sample records in a tight
-    loop until SIGKILL'd by the parent."""
+    loop until SIGKILL'd by the parent. ``max_segment_bytes`` turns on
+    ChainedLog segment rotation (ISSUE 18 satellite) so the kill can
+    land MID-ROTATION, not just mid-append."""
     from evox_tpu.workflows.flightrec import FlightRecorder
 
-    fr = FlightRecorder(directory=stream_dir)
+    fr = FlightRecorder(
+        directory=stream_dir, max_segment_bytes=max_segment_bytes
+    )
     g = 0
     while True:
         g += 1

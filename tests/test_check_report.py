@@ -694,8 +694,8 @@ def test_validator_v11_schema_version_rules():
     """v11 reports must carry a schema_version int that agrees with the
     schema tag suffix; v10-and-earlier reports stay exempt."""
     report = _fresh_report(False)
-    assert report["schema"] == "evox_tpu.run_report/v11"
-    assert report["schema_version"] == 11
+    assert report["schema"] == "evox_tpu.run_report/v12"
+    assert report["schema_version"] == 12
     bad = json.loads(json.dumps(report))
     del bad["schema_version"]
     errors = "\n".join(check_report.validate_run_report(bad))
@@ -854,7 +854,7 @@ def test_validate_file_sniffs_metrics_stream(tmp_path):
 def test_schema_flag_lists_and_detects(tmp_path, capsys):
     assert check_report.main(["--schema"]) == 0
     out = capsys.readouterr().out
-    assert "evox_tpu.run_report/v11" in out
+    assert "evox_tpu.run_report/v12" in out
     assert "evox_tpu.metrics_stream/v1" in out
     from evox_tpu import FlightRecorder
 
@@ -863,3 +863,156 @@ def test_schema_flag_lists_and_detects(tmp_path, capsys):
     assert check_report.main(["--schema", str(fr.stream.path)]) == 0
     out = capsys.readouterr().out
     assert "evox_tpu.metrics_stream/v1" in out
+
+
+# ------------------------------------------------ v12: control plane rules
+
+
+def _control_plane_section():
+    return {
+        "pods": {
+            "opened": 2,
+            "live": ["pod01"],
+            "dead": ["pod00"],
+            "closed": [],
+            "draining": [],
+        },
+        "tenants": {
+            "submitted": 3,
+            "placed": 3,
+            "stolen": 1,
+            "steal_dedup": 0,
+            "results": 3,
+        },
+        "events": {
+            "submit": 3,
+            "place": 3,
+            "steal": 1,
+            "pod_open": 2,
+            "pod_dead": 1,
+        },
+        "ledger": {"records": 10, "rotations": 0, "recoveries": 1},
+        "exactly_once": {"audited_tags": 3, "duplicate_admissions": {}},
+        "steals": [
+            {
+                "tag": "t0",
+                "from_pod": "pod00",
+                "to_pod": "pod01",
+                "bucket": "pop8_dim4_w2",
+                "checkpoint": None,
+            }
+        ],
+        "autoscale": {"policy": None, "events": []},
+    }
+
+
+def test_validator_v12_control_plane_rules():
+    report = {
+        "schema": "evox_tpu.run_report/v12",
+        "schema_version": 12,
+        "control_plane": _control_plane_section(),
+    }
+    assert check_report.validate_run_report(report) == []
+
+    # ANY duplicate admission is a violated law, not a warning
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["exactly_once"]["duplicate_admissions"] = {
+        "t0": 2
+    }
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "admitted twice" in errors
+
+    # ledger-vs-counter coherence: a stolen counter the WAL never saw
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["tenants"]["stolen"] = 2
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "disagrees with ledger steal" in errors
+
+    # the census must be disjoint, and only live pods drain
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["pods"]["closed"] = ["pod00"]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "both dead and closed" in errors
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["pods"]["draining"] = ["pod00"]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "only a live pod can drain" in errors
+
+    # the kind histogram must cover the ledger exactly, with known kinds
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["events"]["submit"] = 4
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "sum" in errors and "ledger.records" in errors
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["events"]["vanish"] = 0
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "unknown ledger kind" in errors
+
+    # a steal that moved nothing, and a steal stream out of step with
+    # its counter
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["steals"][0]["to_pod"] = "pod00"
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "moved nothing" in errors
+    bad = json.loads(json.dumps(report))
+    bad["control_plane"]["steals"] = []
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "tenants.stolen" in errors
+
+
+def test_validator_v12_control_plane_bench_rules():
+    leg = {
+        "metric": "control-plane churn sustained rate",
+        "value": 2.0,
+        "unit": "tenant-gens/s",
+        "vs_baseline": 1.7,
+        "ratio_rounds": [1.6, 1.8],
+    }
+    summary = {
+        "metric": "geomean",
+        "value": 1.0,
+        "unit": "x",
+        "sub_metrics": [leg],
+        "control_plane": {
+            "report": {
+                "schema": "ignored",
+                **_control_plane_section(),
+                "slo": {
+                    "tenant_gens": 18,
+                    "elapsed_s": 2.0,
+                    "tenant_gens_per_s": 9.0,
+                    "admissions": 3,
+                    "preemptions": 0,
+                    "deadline_hits": 0,
+                    "deadline_misses": 0,
+                },
+            },
+            "tenant_gens_per_s": 2.0,
+        },
+    }
+    assert check_report.validate_bench(summary) == []
+
+    # the timed win must be measured, not asserted
+    bad = json.loads(json.dumps(summary))
+    bad["sub_metrics"][0]["vs_baseline"] = None
+    bad["sub_metrics"][0]["ratio_rounds"] = None
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "control-plane leg is missing" in errors
+    assert "no ratio_rounds" in errors
+
+    # the static referee must exist and must show the fault path ran
+    bad = json.loads(json.dumps(summary))
+    del bad["control_plane"]["report"]
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "static referee" in errors
+    bad = json.loads(json.dumps(summary))
+    bad["control_plane"]["report"]["pods"]["dead"] = []
+    bad["control_plane"]["report"]["pods"]["opened"] = 1
+    bad["control_plane"]["report"]["events"]["pod_dead"] = 0
+    bad["control_plane"]["report"]["events"]["pod_open"] = 1
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "no dead pod" in errors
+    bad = json.loads(json.dumps(summary))
+    del bad["control_plane"]["report"]["slo"]
+    errors = "\n".join(check_report.validate_bench(bad))
+    assert "SLO ledger is the leg's referee" in errors

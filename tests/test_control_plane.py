@@ -1,0 +1,532 @@
+"""ISSUE 18: the multi-pod control plane — placement, cross-pod
+work-stealing, demand-driven pod autoscaling, and the kill-anywhere
+recovery law.
+
+Tier structure (the proc_chaos discipline):
+
+- units: ledger kinds/retention refusal, the outstanding-work
+  post-mortem partition, queue-level ``release_continuation`` WAL
+  semantics — no fleet compiles.
+- tier-1 laws: pod-death-mid-sweep digest equality + exactly-once,
+  the mid-steal gateway-death dedup law (in-process, simulated kill),
+  the parked-continuation steal (checkpoint rides to the survivor),
+  and ONE real gateway SIGKILL smoke over the O(10^2) churn trace.
+- slow: the full kill-anywhere matrix (every chunk-boundary round,
+  every WAL half-step, pod-death + gateway-death combinations, the
+  O(10^3) trace) and the real-subprocess control-pod SIGKILL flavor
+  (tools/_multihost_worker.py control-pod mode).
+
+The digest law compares COMPLETED entries only — preempted/evicted
+intermediates carry pod-local bookkeeping; completion (tag,
+generations, telemetry fingerprints) is what acknowledged budget means.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import pytest
+
+from evox_tpu import run_report
+from evox_tpu.workflows.control_plane import (
+    ControlLedger,
+    ControlPlane,
+    PodAutoscaler,
+    _derive_outstanding,
+    _parse_bucket_key,
+)
+from evox_tpu.workflows.elastic import ElasticSpec
+from tests import _control_chaos as cc
+
+
+def _check_report():
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "check_report", repo / "tools" / "check_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------------- units
+
+
+def test_control_ledger_kinds_and_retention_refusal(tmp_path):
+    led = ControlLedger(str(tmp_path))
+    led.append("pod_open", pod="pod00")
+    led.append("submit", tag="t0", n_steps=5, pop=8, dim=4, seed=1)
+    led.append("place", tag="t0", pod="pod00", bucket="pop8_dim4_w2")
+    with pytest.raises(ValueError, match="unknown ControlLedger event kind"):
+        led.append("not_a_kind", x=1)
+    with pytest.raises(ValueError, match="retention"):
+        ControlLedger(str(tmp_path / "r"), retain_segments=2)
+    # adoption replays the chain
+    led2 = ControlLedger(str(tmp_path))
+    assert [r["kind"] for r in led2.records()] == [
+        "pod_open", "submit", "place",
+    ]
+
+
+def test_parse_bucket_key_round_trip():
+    shape = _parse_bucket_key("pop64_dim12_w4")
+    assert (shape.pop, shape.dim, shape.width) == (64, 12, 4)
+    assert shape.key == "pop64_dim12_w4"
+    assert _parse_bucket_key("cache") is None
+    assert _parse_bucket_key("pop8_dim4") is None
+
+
+def test_derive_outstanding_partition():
+    """The host-only post-mortem: submits minus terminal/moved/stolen
+    close-outs, padding dropped, completed entries surfaced."""
+    recs = [
+        {"kind": "submit", "spec_seq": 0, "tag": "a"},
+        {"kind": "submit", "spec_seq": 1, "tag": "b"},
+        {"kind": "submit", "spec_seq": 2, "tag": "_pad_0001"},
+        {"kind": "submit", "spec_seq": 3, "tag": "c"},
+        # a retired: its entry must surface, seq closed
+        {
+            "kind": "retire",
+            "spec_seq": 0,
+            "entry": {"tag": "a", "status": "completed", "generations": 5},
+        },
+        # b preempted -> continuation submitted under a NEW seq
+        {"kind": "preempt", "spec_seq": 1, "entry": {"tag": "b"}},
+        {
+            "kind": "submit",
+            "spec_seq": 4,
+            "tag": "b",
+            "resume_from": "/ck/b",
+            "done": 3,
+        },
+        # a filler close-out: must NOT surface
+        {
+            "kind": "retire",
+            "spec_seq": 2,
+            "entry": {"tag": "_pad_0001", "status": "completed"},
+        },
+        # c stolen away: seq closed without an entry
+        {"kind": "steal", "spec_seq": 3, "tag": "c"},
+    ]
+    outstanding, completed = _derive_outstanding(recs)
+    assert [r["spec_seq"] for r in outstanding] == [4]
+    assert outstanding[0]["resume_from"] == "/ck/b"
+    assert [e["tag"] for e in completed] == ["a"]
+
+
+def test_pod_autoscaler_report():
+    a = PodAutoscaler(scale_up_depth=6, min_pods=1, max_pods=3)
+    rep = a.report()
+    assert rep["scale_up_depth"] == 6
+    assert rep["max_pods"] == 3
+    assert rep["miss_pressure"] is None
+
+
+def test_release_continuation_queue_semantics(tmp_path):
+    """Queue-level WAL: releasing queued work journals a ``steal``
+    record, an unknown seq raises, and recovery honors the release
+    (the stolen seq is NOT requeued). No fleet compile: release acts on
+    the queue's host-side pending list before any start()."""
+    from tests import _proc_chaos as pc
+
+    q = pc.build_queue(tmp_path / "j")
+    pc.submit_all(q)
+    seqs = [s._journal_seq for s in q.pending]
+    desc = q.release_continuation(seqs[2])
+    assert desc["tag"] == "job02" and desc["checkpoint"] is None
+    assert q.counters["stolen"] == 1
+    assert [r["spec_seq"] for r in q.journal.records("steal")] == [seqs[2]]
+    with pytest.raises(KeyError):
+        q.release_continuation(10_000)
+    # recovery must not resurrect the stolen spec
+    q2 = pc.build_queue(tmp_path / "j2")
+    pc.submit_all(q2)
+    q2.release_continuation(q2.pending[0]._journal_seq)
+    from evox_tpu import RunQueue
+
+    q3 = RunQueue.recover(pc.build_workflow(), str(tmp_path / "j2"))
+    assert sorted(s.tag for s in q3.pending) == [
+        f"job{i:02d}" for i in range(1, 12)
+    ]
+
+
+# --------------------------------------------------------------- tier-1 laws
+
+N_SMALL = 6
+
+
+def _ref_digest(tmp_path, n=N_SMALL):
+    plane = cc.build_plane(tmp_path / "ref")
+    for s in cc.churn_specs(n):
+        plane.submit(s)
+    res = plane.serve()
+    plane.close()
+    return cc.result_digest(res)
+
+
+def test_placement_spreads_and_tags_are_unique(tmp_path):
+    plane = cc.build_plane(tmp_path / "p")
+    placed = [plane.submit(s) for s in cc.churn_specs(4)]
+    # least-loaded placement alternates pods instead of piling on one
+    assert sorted(placed) == ["pod00", "pod00", "pod01", "pod01"]
+    with pytest.raises(ValueError, match="duplicate tenant tag"):
+        plane.submit(ElasticSpec(seed=9, n_steps=5, pop=8, dim=4, tag="cp0000"))
+    with pytest.raises(ValueError, match="reserved padding"):
+        plane.submit(
+            ElasticSpec(seed=9, n_steps=5, pop=8, dim=4, tag="_pad_9999")
+        )
+    res = plane.serve()
+    assert len(cc.result_digest(res)) == 4
+    rep = plane.report()
+    assert rep["pods"]["live"] == ["pod00", "pod01"]
+    assert rep["tenants"]["submitted"] == rep["tenants"]["placed"] == 4
+    assert rep["exactly_once"]["duplicate_admissions"] == {}
+    assert rep["events"]["submit"] == 4 and rep["events"]["place"] == 4
+    # the section rides run_report as schema v12 and validates green
+    full = run_report(control_plane=plane)
+    assert full["schema_version"] == 12
+    assert full["control_plane"]["tenants"]["results"] == 4
+    assert _check_report().validate_run_report(full) == []
+    # a fresh gateway over a used directory must refuse (fork protection)
+    with pytest.raises(RuntimeError, match="already holds"):
+        cc.build_plane(tmp_path / "p")
+    plane.close()
+
+
+def test_pod_death_mid_sweep_digest_and_zero_lost_budget(tmp_path):
+    """The core law at n=2 pods: kill a pod mid-sweep (in-process
+    mark_dead — the real-SIGKILL flavors have their own tiers), steal
+    its journals, finish on the survivor. Completed results and
+    telemetry fingerprints equal the no-death run's bit-for-bit, and no
+    acknowledged tenant budget is lost."""
+    ref = _ref_digest(tmp_path)
+    plane = cc.build_plane(tmp_path / "die")
+    for s in cc.churn_specs(N_SMALL):
+        plane.submit(s)
+    for _ in range(2):
+        plane.serve_round()
+    plane.mark_dead("pod00", reason="test")
+    res = plane.serve()
+    assert cc.result_digest(res) == ref
+    # zero lost budget: every acknowledged tenant ran its full budget
+    done = {r["tag"]: r["generations"] for r in res if r["status"] == "completed"}
+    for i, s in enumerate(cc.churn_specs(N_SMALL)):
+        assert done[s.tag] == s.n_steps
+    assert plane.counters["stolen"] > 0
+    rep = plane.report()
+    assert rep["pods"]["dead"] == ["pod00"]
+    assert rep["exactly_once"]["duplicate_admissions"] == {}
+    assert rep["events"]["steal"] == plane.counters["stolen"]
+    # ... and a recovery over the finished directory converges: nothing
+    # to redo, same digest, exactly-once still holds
+    plane2 = ControlPlane.recover(
+        cc.make_factory, str(tmp_path / "die"), width=cc.WIDTH, chunk=cc.CHUNK
+    )
+    res2 = plane2.serve()
+    assert cc.result_digest(res2) == ref
+    assert plane2.report()["exactly_once"]["duplicate_admissions"] == {}
+    plane.close()
+
+
+class _SimKill(BaseException):
+    """In-process stand-in for SIGKILL: unwinds the gateway stack at a
+    WAL half-step without running ANY cleanup handlers on the plane."""
+
+
+@pytest.mark.control_chaos
+def test_mid_steal_gateway_kill_dedup_law(tmp_path):
+    """Kill the gateway exactly between 'durable in target' and the
+    ledger steal record — the worst half-step: the work exists in two
+    pods' journals with no ledger record tying them. Recovery's dedup
+    witness (tag/checkpoint in a live pod's journal) must keep exactly
+    one copy."""
+    from evox_tpu.workflows import control_plane as cp
+
+    ref = _ref_digest(tmp_path)
+    plane = cc.build_plane(tmp_path / "mid")
+    for s in cc.churn_specs(N_SMALL):
+        plane.submit(s)
+    for _ in range(2):
+        plane.serve_round()
+
+    fired = {"n": 0}
+
+    def hook(point):
+        if point.startswith("steal_target_durable:"):
+            fired["n"] += 1
+            raise _SimKill(point)
+
+    cp._CRASH_HOOK = hook
+    try:
+        with pytest.raises(_SimKill):
+            plane.mark_dead("pod00", reason="test")
+    finally:
+        cp._CRASH_HOOK = None
+    assert fired["n"] == 1
+    del plane  # the gateway is gone; only the directories remain
+    plane2 = ControlPlane.recover(
+        cc.make_factory, str(tmp_path / "mid"), width=cc.WIDTH, chunk=cc.CHUNK
+    )
+    # the half-stolen tenant was already durable in the survivor: the
+    # re-derived steal must dedup, not double-admit
+    res = plane2.serve()
+    assert cc.result_digest(res) == ref
+    rep = plane2.report()
+    assert rep["exactly_once"]["duplicate_admissions"] == {}
+    assert plane2.counters["steal_dedup"] >= 1
+
+
+def test_parked_continuation_steals_with_checkpoint(tmp_path):
+    """The continuation flavor of zero-lost-budget: a deadlined tenant
+    preempts a long run, parking it as a checkpoint-backed continuation;
+    the pod then dies with the continuation still queued. The steal must
+    carry the CHECKPOINT to the survivor (not re-run from scratch), and
+    the finished trajectory must equal the no-death run's — both runs
+    share the identical pre-death choreography, so fingerprints compare
+    bit-for-bit."""
+
+    def run(root, die):
+        plane = cc.build_plane(root)
+        longs = [
+            ElasticSpec(seed=i, n_steps=15, pop=8, dim=4, tag=f"long{i}")
+            for i in range(4)
+        ]
+        for s in longs:
+            plane.submit(s)
+        plane.serve_round()
+        plane.submit(
+            ElasticSpec(
+                seed=9, n_steps=4, pop=8, dim=4, tag="urgent", deadline=10
+            )
+        )
+        # serve until the preemption parks a continuation on pod00
+        # (slots full + urgent deadline -> the SLA pass must preempt),
+        # then optionally die with it still queued
+        parked = None
+        for _ in range(40):
+            plane.serve_round()
+            b = plane.pods["pod00"].server._buckets.get("pop8_dim4_w2")
+            conts = list(b.queue.continuations) if b is not None else []
+            if conts:
+                parked = conts[0]
+                break
+        assert parked is not None, "choreography never parked a continuation"
+        assert parked["checkpoint"] is not None
+        if die:
+            plane.mark_dead("pod00", reason="test")
+            assert any(
+                e["with_checkpoint"] for e in plane.steal_events
+            ), "the parked continuation must steal WITH its checkpoint"
+        res = plane.serve()
+        return cc.result_digest(res)
+
+    ref = run(tmp_path / "ref", die=False)
+    got = run(tmp_path / "die", die=True)
+    assert got == ref
+    # every long ran its full budget despite the death
+    assert sorted(t for t, _, _ in got) == [
+        "long0", "long1", "long2", "long3", "urgent",
+    ]
+    assert all(g == (4 if t == "urgent" else 15) for t, g, _ in got)
+
+
+def test_pod_autoscale_grow_and_drain(tmp_path):
+    """Demand-driven census: a deep backlog opens a pod (ledger-first),
+    and an idle pod drains and closes — with its queued work stolen
+    away first, completing elsewhere."""
+    plane = cc.build_plane(
+        tmp_path / "a",
+        n_pods=1,
+        pod_autoscaler=PodAutoscaler(
+            scale_up_depth=2, scale_down_idle_rounds=2, min_pods=1, max_pods=2
+        ),
+    )
+    for s in cc.churn_specs(10):
+        plane.submit(s)
+    plane.serve_round()
+    assert len(plane.live_pods()) == 2, "backlog must open a second pod"
+    assert any(
+        e["action"] == "grow" for e in plane.autoscale_events
+    )
+    res = plane.serve()
+    assert len(cc.result_digest(res)) == 10
+    rep = plane.report()
+    # the drain closed the surplus pod once it went idle
+    assert rep["pods"]["closed"] or len(rep["pods"]["live"]) <= 2
+    assert rep["exactly_once"]["duplicate_admissions"] == {}
+    plane.close()
+
+
+@pytest.mark.control_chaos
+def test_gateway_sigkill_smoke(tmp_path):
+    """Tier-1 real-kill smoke: SIGKILL the whole gateway process mid-way
+    through the O(10^2) churn trace, recover in this process, and match
+    the uncrashed digest exactly."""
+    n = cc.N_TENANTS_T1
+    rc = cc.run_gateway(tmp_path / "g", n, kill_after_rounds=6)
+    assert rc == -9, f"gateway child exit {rc}, expected SIGKILL"
+    plane = ControlPlane.recover(
+        cc.make_factory, str(tmp_path / "g"), width=cc.WIDTH, chunk=cc.CHUNK
+    )
+    res = plane.serve()
+    # uncrashed twin, in-process; the kill landed after every submit was
+    # acknowledged, so the full digest must match
+    ref = cc.build_plane(tmp_path / "ref")
+    for s in cc.churn_specs(n):
+        ref.submit(s)
+    ref_res = ref.serve()
+    assert cc.result_digest(res) == cc.result_digest(ref_res)
+    rep = plane.report()
+    assert rep["tenants"]["submitted"] == n
+    assert rep["exactly_once"]["duplicate_admissions"] == {}
+    assert rep["events"]["recover"] == 1
+    ref.close()
+    plane.close()
+
+
+# ------------------------------------------------------------- slow matrix
+
+
+@pytest.mark.slow
+@pytest.mark.control_chaos
+@pytest.mark.parametrize(
+    "kill_after_rounds,dead_pod,dead_after_rounds,kill_point",
+    [
+        (1, None, None, None),          # right after the first boundary
+        (3, None, None, None),
+        (12, None, None, None),         # deep into the sweep
+        (None, None, None, ("pre_place:", 3)),        # admission WAL, 1st half
+        (None, None, None, ("pre_pod_submit:", 5)),   # admission WAL, 2nd half
+        (8, "pod00", 4, None),          # pod death THEN gateway death
+        (None, "pod00", 2, ("steal_target_durable:", 2)),  # mid-steal SIGKILL
+    ],
+)
+def test_kill_anywhere_matrix(
+    tmp_path, kill_after_rounds, dead_pod, dead_after_rounds, kill_point
+):
+    """The full law: SIGKILL the gateway at every structural point —
+    chunk boundaries, both admission WAL half-steps, mid-steal during a
+    dead-pod drain — and recover to the uncrashed digest with
+    exactly-once admission."""
+    n = cc.N_TENANTS_T1
+    rc = cc.run_gateway(
+        tmp_path / "g",
+        n,
+        kill_after_rounds=kill_after_rounds,
+        kill_point=kill_point,
+        dead_pod=dead_pod,
+        dead_after_rounds=dead_after_rounds,
+    )
+    assert rc == -9, f"gateway child exit {rc}, expected SIGKILL"
+    plane = ControlPlane.recover(
+        cc.make_factory, str(tmp_path / "g"), width=cc.WIDTH, chunk=cc.CHUNK
+    )
+    res = plane.serve()
+    # the law covers ACKNOWLEDGED specs: a kill inside the submission
+    # loop (the pre_place/pre_pod_submit legs) leaves later tenants
+    # never acknowledged — they rightly don't exist after recovery
+    acked = {r["tag"] for r in plane.ledger.records("submit")}
+    ref = cc.build_plane(tmp_path / "ref")
+    for s in cc.churn_specs(n):
+        ref.submit(s)
+    ref_digest = [
+        d for d in cc.result_digest(ref.serve()) if d[0] in acked
+    ]
+    assert cc.result_digest(res) == ref_digest
+    assert plane.report()["exactly_once"]["duplicate_admissions"] == {}
+    ref.close()
+    plane.close()
+
+
+@pytest.mark.slow
+@pytest.mark.control_chaos
+def test_kill_anywhere_large_trace(tmp_path):
+    """The O(10^3) churn trace: the ledger rotates (size-bounded
+    segments), the gateway dies mid-sweep, recovery replays the full
+    segmented history."""
+    n = 1000
+    rc = cc.run_gateway(tmp_path / "g", n, kill_after_rounds=40, timeout=1200.0)
+    assert rc == -9
+    plane = ControlPlane.recover(
+        cc.make_factory, str(tmp_path / "g"), width=cc.WIDTH, chunk=cc.CHUNK
+    )
+    res = plane.serve()
+    digest = cc.result_digest(res)
+    assert len(digest) == n
+    done = {t: g for t, g, _ in digest}
+    for i, s in enumerate(cc.churn_specs(n)):
+        assert done[s.tag] == s.n_steps
+    assert plane.report()["exactly_once"]["duplicate_admissions"] == {}
+    plane.close()
+
+
+@pytest.mark.slow
+@pytest.mark.control_chaos
+def test_control_pod_subprocess_sigkill(tmp_path):
+    """The real-process pod flavor: pods run as their OWN OS processes
+    (tools/_multihost_worker.py control-pod mode) adopting the journals
+    the gateway wrote at submit time. One pod is SIGKILLed mid-serve;
+    the other completes. The gateway then recovers the plane, declares
+    the killed pod dead, steals from its fsynced journals, and finishes
+    — the cross-PROCESS single-writer discipline end-to-end. No
+    jax.distributed involved: a control pod is a single-process server,
+    so this law holds on every supported jaxlib (the PR-13 collective
+    floor only gates the SPMD pod tier)."""
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tools", "_multihost_worker.py")
+    root = tmp_path / "plane"
+    n = 12
+    plane = cc.build_plane(root)
+    for s in cc.churn_specs(n):
+        plane.submit(s)
+    # hand the pods to child processes: the parent's in-memory servers
+    # are now stale and MUST NOT serve or append (single-writer)
+    del plane
+
+    def spawn(pod_id, kill_after_round=None):
+        spec = {
+            "control_pod": True,
+            "repo": repo,
+            "workdir": str(tmp_path),
+            "tag": pod_id,
+            "pod_dir": str(root / "pods" / pod_id),
+            "factory": "tests._control_chaos:make_factory",
+            "width": cc.WIDTH,
+            "chunk": cc.CHUNK,
+            "adopt": True,
+        }
+        if kill_after_round is not None:
+            spec["kill_after_round"] = kill_after_round
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        }
+        return subprocess.Popen(
+            [_sys.executable, worker, json.dumps(spec)],
+            env=env,
+            cwd=str(tmp_path),
+        )
+
+    victim = spawn("pod00", kill_after_round=2)
+    survivor = spawn("pod01")
+    assert victim.wait(timeout=600) == -9, "victim pod was not SIGKILLed"
+    assert survivor.wait(timeout=600) == 0, "survivor pod failed"
+    assert os.path.exists(str(tmp_path / "result_pod01.json"))
+    # the gateway returns: recover, declare the victim dead, finish
+    plane2 = ControlPlane.recover(
+        cc.make_factory, str(root), width=cc.WIDTH, chunk=cc.CHUNK
+    )
+    plane2.mark_dead("pod00", reason="subprocess SIGKILL")
+    res = plane2.serve()
+    digest = cc.result_digest(res)
+    assert len(digest) == n
+    done = {t: g for t, g, _ in digest}
+    for s in cc.churn_specs(n):
+        assert done[s.tag] == s.n_steps
+    assert plane2.report()["exactly_once"]["duplicate_admissions"] == {}
+    plane2.close()

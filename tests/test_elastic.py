@@ -373,6 +373,48 @@ def test_serialized_executable_fresh_process_bitwise(tmp_path):
     assert got["prints"] == parent_prints
 
 
+def _cache_clean_exit_child(cache_dir):
+    """Spawned child: deserialize the fleet's executables from disk,
+    run, then exit NORMALLY — no ``os._exit`` escape hatch. The cache's
+    atexit guard (core/exec_cache.py, PERF_NOTES §23) must drop the
+    deserialized references before jax's ``clear_backends`` runs, or
+    this child segfaults instead of returning 0."""
+    import sys
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    shape = BucketShape(pop=POP, dim=DIM, width=WIDTH)
+    wf = _pso_bucket_wf(shape)
+    cache = ExecutableCache(directory=cache_dir)
+    warm_fleet_cache(wf, cache, bucket=shape)
+    assert cache.counters["disk_hits"] > 0, cache.counters
+    wf.run(wf.init(_keys()), 2)
+    sys.exit(0)  # normal interpreter teardown IS the law under test
+
+
+def test_deserialized_executables_clean_interpreter_exit(tmp_path):
+    """PERF_NOTES §23 regression (PR 18): a fresh process whose
+    executables all came from the disk store exits 0 through normal
+    interpreter teardown — the atexit teardown guard, not ``os._exit``,
+    keeps the deserialized refs from outliving the backend."""
+    cache_dir = str(tmp_path / "store")
+    shape = BucketShape(pop=POP, dim=DIM, width=WIDTH)
+    wf = _pso_bucket_wf(shape)
+    cache = ExecutableCache(directory=cache_dir)
+    warm_fleet_cache(wf, cache, bucket=shape)
+    if cache.counters["saves"] == 0:
+        pytest.skip("backend cannot serialize executables")
+    # deterministic close() is idempotent and non-destructive: the next
+    # lookup pays a disk hit, never a recompile
+    cache.close()
+    cache.close()
+    assert cache._mem == {}
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_cache_clean_exit_child, args=(cache_dir,))
+    p.start()
+    p.join(600)
+    assert p.exitcode == 0
+
+
 # ---------------------------------------------------- zero-retrace admission
 
 

@@ -530,8 +530,8 @@ def test_executor_section_and_trace_validate(tmp_path):
     s = wf.init(jax.random.PRNGKey(4))
     s = ex.run_host(wf, s, 6)
     rep = run_report(wf, s, recorder=rec)
-    assert rep["schema"].endswith("/v11")
-    assert rep["schema_version"] == 11
+    assert rep["schema"].endswith("/v12")
+    assert rep["schema_version"] == 12
     assert rep["executor"]["counters"]["tells"] == 6
     assert rep["executor"]["overlap"]["wall_s"] > 0
     assert check_report.validate_run_report(rep) == []
@@ -573,3 +573,31 @@ def test_run_queue_dispatches_through_executor(tmp_path):
     assert all(r["generations"] >= r["budget"] for r in results)
     assert q.executor.counters["chunks"] >= 2
     assert wf._run_executor is q.executor
+
+
+# ------------------------------------------------------- executor close law
+
+
+def test_executor_close_drains_surfaces_and_is_idempotent(tmp_path):
+    """PR 18: ``close()`` quiesces the executor — pending background
+    lane work is drained (its writes land durably), a lane error still
+    surfaces instead of vanishing into a dead thread, the lane threads
+    are shut down, and the executor stays usable afterwards (lanes
+    re-create lazily)."""
+    ex = GenerationExecutor()
+    out = tmp_path / "lane.txt"
+    ex.submit_background("snap", lambda: out.write_text("durable"))
+    ex.close()
+    assert out.read_text() == "durable"
+    assert ex._named_lanes == {}
+    ex.close()  # idempotent
+
+    def boom():
+        raise RuntimeError("fsync failed")
+
+    ex.submit_background("snap", boom)  # lanes re-create after close
+    time.sleep(0.05)
+    with pytest.raises(RuntimeError, match="fsync failed"):
+        ex.close()
+    # the failed close still tore the lanes down
+    assert ex._named_lanes == {}

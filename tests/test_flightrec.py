@@ -105,6 +105,12 @@ def test_registry_kind_and_monotonicity_laws():
     # cumulative Prometheus semantics: 3.0 lands in every bucket >= 5ms
     assert h["counts"][0] == 0 and h["counts"][1] == 1
     json.dumps(snap, allow_nan=False)  # strict-JSON by construction
+    # values(prefix): the scalar family under a dotted prefix — counters
+    # and gauges only (a histogram snapshot is a dict, not a scalar)
+    reg.observe("q.lat_ms", 1.0)
+    fam = reg.values("q.")
+    assert fam == {"q.chunks": 4, "q.depth": 2, "q.bad": 0}
+    assert reg.values("nope.") == {}
 
 
 def test_openmetrics_parity_registry_vs_evoxtail():

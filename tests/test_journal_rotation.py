@@ -1,0 +1,283 @@
+"""ChainedLog segment rotation laws (ISSUE 18 satellite).
+
+A size-bounded log closes its active file by RENAMING it to
+``FILENAME.NNNNNN`` after the last record's fsync, so:
+
+- the hash chain carries straight across every segment boundary and
+  adoption verifies ONE chain over all segments + the active file;
+- a torn tail can only ever live in the ACTIVE file — any invalid line
+  inside a closed segment is tamper and raises loudly;
+- retention (opt-in) commits a durable ``retention.json`` sidecar
+  BEFORE unlinking the dropped prefix, and never drops the segment
+  holding the newest record of a :attr:`PIN_KINDS` kind (the "newest
+  intact barrier" rule) nor anything newer;
+- ``tools/evoxtail.py`` reads and ``--follow``-tails across rotation
+  without ever writing to a live writer's file — the mid-rotation
+  SIGKILL regression at the bottom pins that with a real child process.
+"""
+
+import io
+import json
+import multiprocessing
+import os
+import re
+import signal
+import sys
+import time
+import warnings
+from pathlib import Path
+
+import pytest
+
+from evox_tpu.workflows.journal import (
+    ChainedLog,
+    JournalIntegrityError,
+    RunJournal,
+)
+
+from tests import _proc_chaos as pc
+
+try:
+    sys.path.insert(0, "tools")
+    import evoxtail
+finally:
+    pass
+
+
+class _PinnedLog(ChainedLog):
+    """A log with a barrier-like pinned kind, for the retention law."""
+
+    FILENAME = "pinned.jsonl"
+    KINDS = ("tick", "barrier")
+    PIN_KINDS = ("barrier",)
+
+
+# ----------------------------------------------------------------- rotation
+
+
+def test_rotation_chain_carries_across_boundary(tmp_path):
+    log = ChainedLog(str(tmp_path), max_segment_bytes=400)
+    for i in range(30):
+        log.append("tick", i=i)
+    segs = sorted(tmp_path.glob(ChainedLog.FILENAME + ".*"))
+    assert log.rotations >= 2
+    assert len(segs) == log.rotations
+    # ordinals are contiguous from 1
+    assert [int(s.name.rsplit(".", 1)[1]) for s in segs] == list(
+        range(1, len(segs) + 1)
+    )
+    # the first record of each later segment chains from the last sha of
+    # the previous one — verified the hard way, straight off the bytes
+    prev_sha = None
+    for seg in segs:
+        lines = seg.read_bytes().strip().split(b"\n")
+        head, tail = json.loads(lines[0]), json.loads(lines[-1])
+        if prev_sha is not None:
+            assert head["prev"] == prev_sha
+        prev_sha = tail["sha"]
+    # adoption stitches all segments + active into one verified chain
+    adopted = ChainedLog(str(tmp_path), max_segment_bytes=400)
+    assert [r["i"] for r in adopted.records()] == list(range(30))
+    assert adopted.torn_tail_dropped == 0
+    # and appends continue the SAME chain (ordinals keep counting up)
+    adopted.append("tick", i=30)
+    assert adopted.records()[-1]["prev"] == prev_sha or adopted.rotations == 0
+
+
+def test_closed_segment_damage_is_tamper_not_crash(tmp_path):
+    log = ChainedLog(str(tmp_path), max_segment_bytes=300)
+    for i in range(20):
+        log.append("tick", i=i)
+    seg = sorted(tmp_path.glob(ChainedLog.FILENAME + ".*"))[0]
+    raw = seg.read_bytes()
+    # tear the closed segment's LAST line — in the active file this
+    # would be the forgivable crash artifact; in a closed segment it
+    # must raise (segments are renamed only after the final fsync)
+    seg.write_bytes(raw[:-20])
+    with pytest.raises(JournalIntegrityError, match="closed"):
+        ChainedLog(str(tmp_path))
+
+
+def test_torn_active_tail_still_repairs_with_segments(tmp_path):
+    log = ChainedLog(str(tmp_path), max_segment_bytes=300)
+    active = tmp_path / ChainedLog.FILENAME
+    i = 0
+    # keep appending until the newest record sits in the ACTIVE file
+    # (an append can land exactly on the rotation boundary, leaving the
+    # active file momentarily absent)
+    while i < 20 or not (active.exists() and active.stat().st_size > 0):
+        log.append("tick", i=i)
+        i += 1
+    n_full = len(log.records())
+    with open(active, "r+b") as f:
+        f.truncate(active.stat().st_size - 10)
+    with pytest.warns(UserWarning, match="torn tail"):
+        adopted = ChainedLog(str(tmp_path))
+    assert adopted.torn_tail_dropped == 1
+    assert len(adopted.records()) == n_full - 1
+
+
+def test_retention_commits_sidecar_and_adopts_shortened_chain(tmp_path):
+    log = ChainedLog(
+        str(tmp_path), max_segment_bytes=300, retain_segments=2
+    )
+    for i in range(40):
+        log.append("tick", i=i)
+    assert log.segments_dropped > 0
+    side = json.loads((tmp_path / "retention.json").read_bytes())
+    assert side["dropped_through_seq"] >= 0
+    segs = sorted(tmp_path.glob(ChainedLog.FILENAME + ".*"))
+    assert len(segs) <= 2
+    # adoption verifies a chain whose head is the committed cut, not
+    # genesis; the surviving records are exactly the post-cut suffix
+    adopted = ChainedLog(str(tmp_path))
+    recs = adopted.records()
+    assert recs[0]["seq"] == side["dropped_through_seq"] + 1
+    assert [r["seq"] for r in recs] == list(
+        range(recs[0]["seq"], recs[0]["seq"] + len(recs))
+    )
+    # appends continue seamlessly after the retained-away prefix
+    adopted.append("tick", i=99)
+    assert adopted.records()[-1]["seq"] == recs[-1]["seq"] + 1
+
+
+def test_retention_never_drops_newest_pinned_barrier(tmp_path):
+    log = _PinnedLog(str(tmp_path), max_segment_bytes=250, retain_segments=1)
+    log.append("barrier", name="b0")
+    for i in range(40):
+        log.append("tick", i=i)
+    # the newest barrier sits in the OLDEST segment — retention must
+    # stall rather than drop it, even though retain_segments=1
+    segs = sorted(tmp_path.glob(_PinnedLog.FILENAME + ".*"))
+    assert len(segs) > 1
+    barrier_seq = log.records(kind="barrier")[-1]["seq"]
+    head_seqs = [
+        json.loads(s.read_bytes().split(b"\n", 1)[0])["seq"] for s in segs
+    ]
+    assert min(head_seqs) <= barrier_seq
+    assert any(
+        r["kind"] == "barrier"
+        for s in segs
+        for r in map(json.loads, s.read_bytes().strip().split(b"\n"))
+    )
+    # a NEWER barrier un-pins the old prefix: retention resumes
+    log.append("barrier", name="b1")
+    for i in range(40):
+        log.append("tick", i=100 + i)
+    assert log.segments_dropped > 0
+    surviving = _PinnedLog(str(tmp_path)).records(kind="barrier")
+    assert [r["name"] for r in surviving][-1] == "b1"
+
+
+def test_run_journal_refuses_retention(tmp_path):
+    with pytest.raises(ValueError, match="retention"):
+        RunJournal(str(tmp_path), retain_segments=3)
+    # rotation alone is fine — recovery replays every submit from the
+    # stitched chain
+    j = RunJournal(str(tmp_path), max_segment_bytes=200)
+    for i in range(10):
+        j.append("health", note=f"h{i}")
+    assert j.rotations >= 1
+    assert len(RunJournal(str(tmp_path)).records()) == 10
+
+
+# ---------------------------------------------------------------- evoxtail
+
+
+def test_evoxtail_read_records_stitches_segments(tmp_path):
+    from evox_tpu.workflows.flightrec import FlightRecorder
+
+    fr = FlightRecorder(directory=str(tmp_path), max_segment_bytes=500)
+    for g in range(1, 25):
+        fr.event("queue.tick", g=g)
+    assert fr.stream.rotations >= 1
+    path = str(tmp_path / "metrics.jsonl")
+    recs = evoxtail.read_records(path)
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(seqs) == len(set(seqs))
+    gs = [r["g"] for r in recs if r.get("name") == "queue.tick"]
+    assert gs == list(range(1, 25))
+
+
+class _LineSink(io.StringIO):
+    """A text sink ``follow`` can print to, with a line accessor that is
+    safe to poll from the test thread."""
+
+    def lines(self):
+        return self.getvalue().splitlines()
+
+
+@pytest.mark.proc_chaos
+def test_evoxtail_follow_across_rotation_mid_kill(tmp_path):
+    """The satellite's regression proper: a live writer rotating every
+    few records is SIGKILL'd while ``evoxtail --follow`` tails it. The
+    follow output must contain every event exactly once, in order,
+    across every rotation it witnessed — and the tail must never have
+    written to the stream: adoption after the kill still verifies the
+    full chain (at most the usual one torn tail)."""
+    import threading
+
+    sdir = tmp_path / "stream"
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(
+        target=pc.metrics_child_main,
+        args=(str(sdir), 4_000),
+        daemon=True,
+    )
+    p.start()
+    path = str(sdir / "metrics.jsonl")
+    sink = _LineSink()
+    t = threading.Thread(
+        target=evoxtail.follow,
+        args=(path,),
+        kwargs={"interval_s": 0.05, "out": sink},
+        daemon=True,
+    )
+    t.start()
+    # wait until the tail has seen events spanning >= 2 rotations
+    deadline = time.time() + 120.0
+    seen_enough = False
+    while time.time() < deadline:
+        if len(evoxtail.segment_paths(path)) >= 2:
+            gs = _tick_gs(sink.lines())
+            if len(gs) >= 30:
+                seen_enough = True
+                break
+        time.sleep(0.02)
+    assert seen_enough, "tail never spanned a rotation"
+    os.kill(p.pid, signal.SIGKILL)
+    p.join()
+    assert p.exitcode == -signal.SIGKILL
+    # give the follower a few polls to drain what the writer flushed
+    time.sleep(0.5)
+    gs = _tick_gs(sink.lines())
+    # exactly-once, in-order, gap-free: the follow never dropped a
+    # record at a boundary, never re-printed one after a rotation
+    assert gs == list(range(1, len(gs) + 1))
+    # read-only law: adoption of the killed stream still verifies the
+    # full multi-segment chain (the tailer wrote nothing, truncated
+    # nothing)
+    from evox_tpu.workflows.flightrec import MetricsStream
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        stream = MetricsStream(str(sdir))
+    assert stream.torn_tail_dropped in (0, 1)
+    all_gs = [
+        r["g"] for r in stream.records(kind="event")
+        if r.get("name") == "queue.tick"
+    ]
+    assert all_gs == list(range(1, len(all_gs) + 1))
+    assert all_gs[: len(gs)] == gs
+
+
+_TICK = re.compile(r"event\s+queue\.tick g=(\d+)")
+
+
+def _tick_gs(lines):
+    out = []
+    for ln in lines:
+        m = _TICK.search(ln)
+        if m:
+            out.append(int(m.group(1)))
+    return out

@@ -110,6 +110,22 @@ def test_telemetry_modules_exist_and_are_callback_free():
         assert rel not in users, f"{rel} must not use host callbacks"
 
 
+def test_control_plane_is_callback_free():
+    """The multi-pod gateway (ISSUE 18) is host-side scheduling by
+    construction — ledger appends, journal parses, checkpoint-manifest
+    probes. A callback anywhere in it (or in the serving modules it
+    composes) would break the one deployment it exists for: a gateway
+    over axon-tunneled TPU pods."""
+    users = _scan()
+    for rel in (
+        "workflows/control_plane.py",
+        "workflows/journal.py",
+        "workflows/flightrec.py",
+    ):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"
+
+
 def test_roofline_modules_are_callback_free():
     """The roofline analytics layer must hold the axon constraint by
     construction: AOT lowering/compiling (core/xla_cost.py) and the

@@ -377,8 +377,8 @@ def test_pod_shrink_resume_8_to_4_analog(tmp_path):
 
         # v9 report + trace schema, incl. reform↔resume coherence
         rep = run_report(wf1, final)
-        assert rep["schema"] == "evox_tpu.run_report/v11"
-        assert rep["schema_version"] == 11
+        assert rep["schema"] == "evox_tpu.run_report/v12"
+        assert rep["schema_version"] == 12
         pod = rep["pod_supervisor"]
         assert pod["outcome"] == "resumed"
         kinds = [e["event"] for e in pod["events"]]
@@ -557,3 +557,41 @@ def test_journalled_pod_events_verify(tmp_path):
     assert RunJournal.verify(jdir) == 2
     kinds = [r["kind"] for r in RunJournal(jdir).records()]
     assert kinds == ["pod_join", "pod_failure"]
+
+
+# ------------------------------------------- deadline-vs-coord-abort clamp
+
+
+def test_deadline_clamped_against_coord_abort(monkeypatch):
+    """PERF_NOTES §25 (PR 18): in a REAL multi-process pod a supervisor
+    deadline that cannot beat jaxlib's ~10 s coordination-heartbeat
+    abort is clamped at construction with a warning — pod faults must be
+    classified, not die by SIGABRT."""
+    monkeypatch.setattr(dist, "_dist_process_info", lambda: (0, 4))
+    with pytest.warns(UserWarning, match="coordination heartbeat abort"):
+        sup = PodSupervisor(deadline_s=30.0, heartbeat_interval_s=1.0)
+    # budget = 10.0 (abort) - 0.5 (margin) - (2*interval + 0.2) slack
+    assert sup.deadline_s == pytest.approx(7.3)
+    # the derived checkpoint deadline follows the clamp
+    assert sup.checkpoint_deadline_s == pytest.approx(6.0 * 7.3)
+    # an explicit checkpoint_deadline_s is the caller's choice — kept
+    with pytest.warns(UserWarning, match="clamping"):
+        sup2 = PodSupervisor(
+            deadline_s=30.0,
+            heartbeat_interval_s=1.0,
+            checkpoint_deadline_s=120.0,
+        )
+    assert sup2.checkpoint_deadline_s == 120.0
+
+
+def test_deadline_within_budget_untouched(monkeypatch, recwarn):
+    """Both safe sides: a multi-process deadline inside the abort budget
+    and ANY single-process deadline (no coordination client to race)
+    pass through unclamped and warning-free."""
+    monkeypatch.setattr(dist, "_dist_process_info", lambda: (0, 4))
+    sup = PodSupervisor(deadline_s=5.0, heartbeat_interval_s=1.0)
+    assert sup.deadline_s == 5.0
+    monkeypatch.setattr(dist, "_dist_process_info", lambda: (0, 1))
+    solo = PodSupervisor(deadline_s=30.0, heartbeat_interval_s=1.0)
+    assert solo.deadline_s == 30.0
+    assert not [w for w in recwarn if "clamp" in str(w.message)]

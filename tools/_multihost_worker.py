@@ -73,6 +73,13 @@ POD_FAULT_EXIT = 23
 
 def main() -> None:
     spec = json.loads(sys.argv[1])
+    if spec.get("control_pod"):
+        # ISSUE 18: one POD DRIVER of the multi-pod control plane — a
+        # plain single-process server over its own pod directory, no
+        # jax.distributed (the gateway composes pods; each pod is just
+        # an ElasticServer whose durable surfaces the gateway can read)
+        _control_pod_run(spec)
+        return
     pid = int(spec["pid"])
     nprocs = int(spec["nprocs"])
     n_local = int(spec["n_local"])
@@ -502,6 +509,95 @@ def _dump(result, workdir, tag):
     with open(path + ".tmp", "w") as f:
         json.dump(result, f)
     os.replace(path + ".tmp", path)
+
+
+# ------------------------------------------------------------- control pod
+
+
+def _control_pod_run(spec: dict) -> None:
+    """CONTROL-POD mode (ISSUE 18): one pod driver of the multi-pod
+    control plane, as its OWN process. The parent gateway owns the
+    ledger and the pod's journal/checkpoint directories; this child
+    either ADOPTS the pod (``adopt: true`` — recover every journaled
+    bucket, the single-writer handoff: the parent must not append to
+    the pod's journals while this process lives) or submits fresh specs
+    from ``specs_file`` (a JSON list of elastic submit records), then
+    serves round by round. ``kill_after_round: N`` SIGKILLs the process
+    at that round boundary — the real-process pod-death flavor of the
+    kill-anywhere law; the parent then steals from the journals this
+    process fsynced. Spec keys: repo, workdir, tag, pod_dir, factory
+    ("module:callable"), width, chunk, cache_dir?, specs_file?, adopt?,
+    kill_after_round?, n_local?."""
+    repo = spec["repo"]
+    workdir = spec["workdir"]
+    tag = spec.get("tag", "control_pod")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        f"{int(spec.get('n_local', 8))} --xla_backend_optimization_level=0"
+    )
+    sys.path.insert(0, repo)
+    for extra in spec.get("sys_path", []):
+        sys.path.insert(0, extra)
+
+    import faulthandler
+
+    faulthandler.enable()
+    hard = float(spec.get("harness_timeout", 600.0))
+    faulthandler.dump_traceback_later(max(hard * 0.8, 5.0), exit=False)
+
+    import importlib
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from evox_tpu.workflows.control_plane import (
+        _elastic_spec_from_record,
+        _parse_bucket_key,
+    )
+    from evox_tpu.workflows.elastic import ElasticServer
+    from evox_tpu.workflows.journal import jsonable
+
+    mod_name, fn_name = spec["factory"].split(":")
+    factory = getattr(importlib.import_module(mod_name), fn_name)
+    pod_dir = spec["pod_dir"]
+    server = ElasticServer(
+        factory=factory,
+        width=int(spec.get("width", 2)),
+        chunk=int(spec.get("chunk", 3)),
+        cache_dir=spec.get("cache_dir"),
+        journal_dir=os.path.join(pod_dir, "journal"),
+        checkpoint_dir=os.path.join(pod_dir, "ckpt"),
+    )
+    if spec.get("adopt"):
+        jroot = os.path.join(pod_dir, "journal")
+        for name in sorted(os.listdir(jroot)) if os.path.isdir(jroot) else []:
+            shape = _parse_bucket_key(name)
+            if shape is not None and os.path.isdir(os.path.join(jroot, name)):
+                server.recover_bucket(shape)
+    if spec.get("specs_file"):
+        with open(spec["specs_file"]) as f:
+            recs = json.load(f)
+        for rec in recs:
+            server.submit(_elastic_spec_from_record(rec))
+    kill_after = spec.get("kill_after_round")
+    rounds = 0
+    while server.has_work():
+        server.serve_round()
+        rounds += 1
+        if kill_after is not None and rounds >= int(kill_after):
+            os.kill(os.getpid(), signal.SIGKILL)
+    result = jsonable(
+        {
+            "tag": tag,
+            "pod_dir": pod_dir,
+            "rounds": rounds,
+            "results": server.results(),
+        }
+    )
+    _dump(result, workdir, tag)
+    print(f"CONTROL_POD {tag} OK", flush=True)
 
 
 # ---------------------------------------------------------------- pod chaos

@@ -217,6 +217,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     surrogate = report.get("surrogate")
     if surrogate is not None:
         errors += _validate_surrogate(surrogate, where)
+    control_plane = report.get("control_plane")
+    if control_plane is not None:
+        errors += _validate_control_plane(control_plane, where)
     tenancy = report.get("tenancy")
     if tenancy is not None:
         errors += _validate_tenancy(tenancy, where)
@@ -384,6 +387,227 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
                             "pipeline_tell entries show zero alias bytes — "
                             "the aliasing never reached the compiled program"
                         )
+    return errors
+
+
+# v12 (ISSUE 18, workflows/control_plane.py): the multi-pod gateway's
+# global ledger event-kind whitelist
+CONTROL_LEDGER_KINDS = {
+    "submit",
+    "place",
+    "steal",
+    "autoscale",
+    "pod_open",
+    "pod_dead",
+    "pod_close",
+    "recover",
+}
+
+
+def _validate_control_plane(cp: Any, where: str) -> List[str]:
+    """The ``control_plane`` section (schema v12, ISSUE 18,
+    workflows/control_plane.py): a disjoint pod census whose draining
+    set is live, known ledger event kinds whose counts sum to the
+    ledger's record count, ledger-vs-counter coherence for the
+    transitions both sides record (submit/steal/pod_open/pod_dead), and
+    the exactly-once admission audit — ANY duplicate admission across
+    the live pods' journals is a violated law, not a warning."""
+    errors: List[str] = []
+    if not isinstance(cp, dict):
+        return [f"{where}: control_plane is not an object"]
+    pods = cp.get("pods")
+    live: List[str] = []
+    if not isinstance(pods, dict):
+        errors.append(f"{where}: control_plane.pods missing")
+        pods = {}
+    opened = pods.get("opened")
+    if not isinstance(opened, int) or opened < 0:
+        errors.append(
+            f"{where}: control_plane.pods.opened missing or not a "
+            "non-negative int"
+        )
+    census: dict = {}
+    for key in ("live", "dead", "closed", "draining"):
+        v = pods.get(key)
+        if not isinstance(v, list) or not all(
+            isinstance(p, str) for p in v
+        ):
+            errors.append(
+                f"{where}: control_plane.pods.{key} missing or not a "
+                "list of pod ids"
+            )
+            census[key] = set()
+        else:
+            census[key] = set(v)
+    live = sorted(census.get("live", ()))
+    for a, b in (("live", "dead"), ("live", "closed"), ("dead", "closed")):
+        both = census[a] & census[b]
+        if both:
+            errors.append(
+                f"{where}: control_plane.pods {sorted(both)} listed as "
+                f"both {a} and {b} — the census must be disjoint"
+            )
+    if not census["draining"] <= census["live"]:
+        errors.append(
+            f"{where}: control_plane.pods.draining "
+            f"{sorted(census['draining'] - census['live'])} not live — "
+            "only a live pod can drain"
+        )
+    if isinstance(opened, int) and opened < sum(
+        len(census[k]) for k in ("live", "dead", "closed")
+    ):
+        errors.append(
+            f"{where}: control_plane.pods.opened {opened} < the census "
+            "total — pods exist the ledger never opened"
+        )
+    tenants = cp.get("tenants")
+    if not isinstance(tenants, dict):
+        errors.append(f"{where}: control_plane.tenants missing")
+        tenants = {}
+    for key in ("submitted", "placed", "stolen", "steal_dedup", "results"):
+        v = tenants.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: control_plane.tenants.{key} missing or not a "
+                "non-negative int"
+            )
+    events = cp.get("events")
+    if not isinstance(events, dict):
+        errors.append(f"{where}: control_plane.events missing")
+        events = {}
+    total = 0
+    for kind, count in events.items():
+        if kind not in CONTROL_LEDGER_KINDS:
+            errors.append(
+                f"{where}: control_plane.events has unknown ledger kind "
+                f"{kind!r}"
+            )
+        if not isinstance(count, int) or count < 0:
+            errors.append(
+                f"{where}: control_plane.events.{kind} not a "
+                "non-negative int"
+            )
+        else:
+            total += count
+    ledger = cp.get("ledger")
+    if not isinstance(ledger, dict):
+        errors.append(f"{where}: control_plane.ledger missing")
+        ledger = {}
+    for key in ("records", "rotations", "recoveries"):
+        v = ledger.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: control_plane.ledger.{key} missing or not a "
+                "non-negative int"
+            )
+    if events and isinstance(ledger.get("records"), int) and total != ledger[
+        "records"
+    ]:
+        errors.append(
+            f"{where}: control_plane.events sum {total} != ledger.records "
+            f"{ledger['records']} — the kind histogram lost records"
+        )
+    # ledger-vs-counter coherence: both sides record these transitions
+    # (the gateway's counter at the call site, the ledger as the WAL),
+    # and recovery rebuilds the counters FROM the ledger — so they must
+    # agree exactly
+    for counter_side, ledger_kind, counter in (
+        ("tenants.submitted", "submit", tenants.get("submitted")),
+        ("tenants.stolen", "steal", tenants.get("stolen")),
+        ("pods.opened", "pod_open", opened),
+        (
+            "pods.dead census",
+            "pod_dead",
+            len(census["dead"]) if census.get("dead") is not None else None,
+        ),
+    ):
+        led = events.get(ledger_kind, 0)
+        if isinstance(counter, int) and isinstance(led, int) and counter != led:
+            errors.append(
+                f"{where}: control_plane.{counter_side} {counter} "
+                f"disagrees with ledger {ledger_kind} count {led}"
+            )
+    # placements can exceed the counter after a recovery replay
+    # (re-placements reuse the original place record) — only the
+    # impossible direction is a violation
+    placed = tenants.get("placed")
+    if isinstance(placed, int) and isinstance(
+        events.get("place"), int
+    ) and placed > events["place"]:
+        errors.append(
+            f"{where}: control_plane.tenants.placed {placed} > ledger "
+            f"place count {events['place']} — a placement the WAL never "
+            "saw"
+        )
+    eo = cp.get("exactly_once")
+    if not isinstance(eo, dict):
+        errors.append(f"{where}: control_plane.exactly_once missing")
+    else:
+        if not isinstance(eo.get("audited_tags"), int):
+            errors.append(
+                f"{where}: control_plane.exactly_once.audited_tags "
+                "missing or not an int"
+            )
+        dup = eo.get("duplicate_admissions")
+        if not isinstance(dup, dict):
+            errors.append(
+                f"{where}: control_plane.exactly_once."
+                "duplicate_admissions missing or not an object"
+            )
+        elif dup:
+            errors.append(
+                f"{where}: control_plane.exactly_once reports duplicate "
+                f"admissions {dup} — a spec was admitted twice; the "
+                "steal-dedup law is violated"
+            )
+    steals = cp.get("steals")
+    if not isinstance(steals, list):
+        errors.append(f"{where}: control_plane.steals missing")
+    else:
+        if isinstance(tenants.get("stolen"), int) and len(
+            steals
+        ) != tenants["stolen"]:
+            errors.append(
+                f"{where}: control_plane.steals has {len(steals)} "
+                f"events but tenants.stolen is {tenants['stolen']}"
+            )
+        for i, ev in enumerate(steals):
+            loc = f"{where}: control_plane.steals[{i}]"
+            if not isinstance(ev, dict):
+                errors.append(f"{loc} is not an object")
+                continue
+            for key in ("tag", "from_pod", "to_pod"):
+                if not isinstance(ev.get(key), str):
+                    errors.append(f"{loc}.{key} missing or not a string")
+            if ev.get("from_pod") == ev.get("to_pod"):
+                errors.append(
+                    f"{loc}: from_pod == to_pod {ev.get('to_pod')!r} — a "
+                    "steal that moved nothing"
+                )
+    auto = cp.get("autoscale")
+    if not isinstance(auto, dict):
+        errors.append(f"{where}: control_plane.autoscale missing")
+    elif not isinstance(auto.get("events"), list):
+        errors.append(f"{where}: control_plane.autoscale.events missing")
+    slo = cp.get("slo")
+    if slo is not None:
+        errors += _validate_slo_ledger(slo, f"{where}: control_plane")
+    metrics = cp.get("metrics")
+    if metrics is not None:
+        if not isinstance(metrics, dict):
+            errors.append(f"{where}: control_plane.metrics not an object")
+        else:
+            for name, v in metrics.items():
+                if not str(name).startswith("control."):
+                    errors.append(
+                        f"{where}: control_plane.metrics.{name} outside "
+                        "the control.* namespace"
+                    )
+                if not _num(v):
+                    errors.append(
+                        f"{where}: control_plane.metrics.{name} "
+                        "non-numeric"
+                    )
     return errors
 
 
@@ -865,6 +1089,9 @@ JOURNAL_KINDS = {
     # v7 (PR 12): SLA preemption and elastic-autoscale close-outs
     "preempt",
     "autoscale",
+    # v12 (ISSUE 18): a queued continuation/spec released because the
+    # multi-pod gateway re-placed it on another pod
+    "steal",
     # v9 (ISSUE 14): pod membership transitions (core/pod_supervisor.py)
     "pod_join",
     "pod_failure",
@@ -1498,6 +1725,11 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
             # measured bare-vs-instrumented wall ratio — the PR-16
             # <= 2% overhead law must be measured, not asserted
             ("metrics-plane", "its uninstrumented-baseline ratio"),
+            # v12: the control_plane leg's vs_baseline is the measured
+            # multi-pod-churn vs single-pod-sequential sustained
+            # tenant-gens/sec ratio (ISSUE 18); the gateway report's
+            # exactly-once audit is its static referee
+            ("control-plane", "its single-pod sequential-baseline ratio"),
         ):
             if keyword not in metric_l:
                 continue
@@ -1675,6 +1907,45 @@ def validate_bench(summary: Any, where: str = "bench") -> List[str]:
     sg = summary.get("surrogate")
     if isinstance(sg, dict) and "error" not in sg:
         errors += _validate_surrogate_summary(sg, where)
+    cps = summary.get("control_plane")
+    if isinstance(cps, dict) and "error" not in cps:
+        errors += _validate_control_plane_summary(cps, where)
+    return errors
+
+
+def _validate_control_plane_summary(cps: dict, where: str) -> List[str]:
+    """The bench summary's ``control_plane`` key (schema v12, ISSUE 18):
+    the timed leg (sustained tenant-gens/sec under churn, multi-pod vs a
+    single-pod sequential baseline) must carry the gateway's own report
+    as its STATIC REFEREE — the exactly-once admission audit and the SLO
+    ledger — and the churn must actually have exercised the fault path:
+    a pod died mid-sweep and its work was re-placed (stolen), or the
+    speedup was measured on the happy path only."""
+    errors: List[str] = []
+    rep = cps.get("report")
+    if not isinstance(rep, dict):
+        errors.append(
+            f"{where}: control_plane.report missing — the gateway report "
+            "(exactly-once audit + SLO ledger) is the leg's static referee"
+        )
+        return errors
+    errors += _validate_control_plane(rep, f"{where}: control_plane")
+    if not isinstance(rep.get("slo"), dict):
+        errors.append(
+            f"{where}: control_plane.report.slo missing — the SLO ledger "
+            "is the leg's referee"
+        )
+    if not (rep.get("pods") or {}).get("dead"):
+        errors.append(
+            f"{where}: control_plane.report shows no dead pod — the "
+            "churn leg must inject a pod death"
+        )
+    tenants = rep.get("tenants") or {}
+    if not isinstance(tenants.get("stolen"), int) or tenants["stolen"] < 1:
+        errors.append(
+            f"{where}: control_plane.report.tenants.stolen < 1 — the "
+            "dead pod's outstanding work was never re-placed"
+        )
     return errors
 
 
@@ -1929,7 +2200,7 @@ def validate_file(path: str) -> List[str]:
 #: ``--schema`` prints so drivers/tests can pin the supported range
 #: without parsing the module
 SUPPORTED_SCHEMAS = (
-    "evox_tpu.run_report/v11 (validates v1-v11)",
+    "evox_tpu.run_report/v12 (validates v1-v12)",
     "evox_tpu.metrics_stream/v1",
     "bench summary (sub_metrics)",
     "bench envelope (cmd+tail)",

@@ -65,13 +65,39 @@ def parse_line(line: bytes) -> Optional[dict]:
     return rec if isinstance(rec, dict) else None
 
 
+def segment_paths(path: str) -> List[str]:
+    """Closed rotation segments of ``path`` (``metrics.jsonl.NNNNNN``),
+    oldest -> newest — a size-bounded writer (ChainedLog rotation)
+    renames the active file aside; readers stitch them back in order."""
+    d, name = os.path.split(os.path.abspath(path))
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return []
+    segs = [
+        e
+        for e in entries
+        if e.startswith(name + ".") and e[len(name) + 1 :].isdigit()
+    ]
+    return [os.path.join(d, e) for e in sorted(segs)]
+
+
+def _seg_ordinal(seg_path: str) -> int:
+    return int(seg_path.rsplit(".", 1)[1])
+
+
 def read_records(path: str) -> List[dict]:
     records: List[dict] = []
-    with open(path, "rb") as f:
-        for line in f:
-            rec = parse_line(line)
-            if rec is not None:
-                records.append(rec)
+    for p in segment_paths(path) + [path]:
+        try:
+            f = open(p, "rb")
+        except OSError:
+            continue  # a segment retained away mid-listing, or no active
+        with f:
+            for line in f:
+                rec = parse_line(line)
+                if rec is not None:
+                    records.append(rec)
     return records
 
 
@@ -234,26 +260,77 @@ def follow(path: str, interval_s: float = 0.5, out=sys.stdout) -> None:
     """tail -f: print records already present, then poll for appends.
     Only COMPLETE lines are emitted — a partial line (an append caught
     mid-write, or the torn tail of a crash) stays buffered until its
-    newline lands, so a record is never printed twice or half."""
+    newline lands, so a record is never printed twice or half.
+
+    Rotation-aware: the writer closes a segment by RENAMING the active
+    file aside (ChainedLog segment rotation), which preserves its
+    inode — so the file being followed can be recognized after it
+    rotates out. Each poll first drains every closed segment not yet
+    consumed, oldest first: the one whose inode matches the file we
+    were mid-reading continues from the saved offset, any other is
+    read whole. The active file is then followed — but only when it is
+    provably the chain successor (same inode as before, or a fresh
+    attach with no unconsumed closed segment), so a burst of rotations
+    between two polls never skips, splits, or duplicates a record. The
+    tool itself still never writes — a live writer's file is never
+    truncated by tailing it."""
     pos = 0
     buf = b""
+    ino: Optional[int] = None
+    segs0 = segment_paths(path)
+    # segments already closed when the tail starts are history, not the
+    # live stream — follow begins at the current active file
+    last_ord = _seg_ordinal(segs0[-1]) if segs0 else 0
+
+    def emit(data: bytes) -> None:
+        nonlocal buf
+        buf += data
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            rec = parse_line(line)
+            if rec is not None:
+                print(fmt_record(rec), file=out, flush=True)
+
     while True:
+        for p in [
+            q for q in segment_paths(path) if _seg_ordinal(q) > last_ord
+        ]:
+            try:
+                f = open(p, "rb")
+            except OSError:  # retained away mid-drain — records are gone
+                last_ord = _seg_ordinal(p)
+                pos, buf, ino = 0, b"", None
+                continue
+            with f:
+                fst = os.fstat(f.fileno())
+                if ino is not None and fst.st_ino == ino:
+                    f.seek(pos)  # the file we were mid-reading, closed
+                else:
+                    buf = b""  # a file we never attached to — read whole
+                emit(f.read())
+            last_ord = _seg_ordinal(p)
+            pos, ino = 0, None
         try:
-            size = os.path.getsize(path)
+            f = open(path, "rb")
         except OSError:
-            size = 0
-        if size < pos:  # rotated/truncated (a fresh adoption) — restart
-            pos, buf = 0, b""
-        if size > pos:
-            with open(path, "rb") as f:
-                f.seek(pos)
-                buf += f.read()
-                pos = f.tell()
-            while b"\n" in buf:
-                line, buf = buf.split(b"\n", 1)
-                rec = parse_line(line)
-                if rec is not None:
-                    print(fmt_record(rec), file=out, flush=True)
+            f = None  # no active file right now (mid-rotation)
+        if f is not None:
+            with f:
+                fst = os.fstat(f.fileno())
+                if ino is not None and fst.st_ino != ino:
+                    pass  # our file rotated out — the next poll drains it
+                elif ino is None and any(
+                    _seg_ordinal(q) > last_ord for q in segment_paths(path)
+                ):
+                    pass  # a rotation landed since the drain — drain first
+                else:
+                    ino = fst.st_ino
+                    if fst.st_size < pos:  # truncated (a fresh adoption)
+                        pos, buf = 0, b""
+                    if fst.st_size > pos:
+                        f.seek(pos)
+                        emit(f.read())
+                        pos = f.tell()
         time.sleep(interval_s)
 
 
@@ -291,7 +368,7 @@ def main(argv: List[str]) -> int:
             follow(path, interval_s=args.interval)
         except KeyboardInterrupt:
             return 0
-    if not os.path.exists(path):
+    if not os.path.exists(path) and not segment_paths(path):
         print(f"evoxtail: no stream at {path}", file=sys.stderr)
         return 1
     records = read_records(path)
