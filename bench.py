@@ -2301,6 +2301,41 @@ def main(argv=None) -> None:
             }
         )
     )
+    try:
+        # keep the cross-PR ratio history current: fold this run plus the
+        # archived BENCH_r*.json rounds into BENCH_TRAJECTORY.json (the
+        # live run rides along as a provisional round until the driver
+        # archives it)
+        import glob as _glob
+        import re as _re
+
+        _repo = os.path.dirname(os.path.abspath(__file__))
+        if _repo not in sys.path:
+            sys.path.insert(0, _repo)
+        from tools import bench_trajectory as _bt
+        _rounds = [
+            int(m.group(1))
+            for p in _glob.glob(os.path.join(_repo, _bt.ROUND_GLOB))
+            if (m := _re.search(r"r(\d+)", os.path.basename(p)))
+        ]
+        _live = _bt.summary_as_round(
+            {
+                "metric": f"geomean speedup over reference ({covered})",
+                "value": round(geomean, 3) if geomean else None,
+                "unit": "x",
+                "vs_baseline": round(geomean, 3) if geomean else None,
+                "sub_metrics": results,
+            },
+            round_no=max(_rounds, default=0) + 1,
+        )
+        _, _tpath = _bt.rebuild(_repo, extra_rounds=[_live])
+        print(f"bench trajectory updated: {_tpath}", file=sys.stderr)
+    except Exception as e:
+        print(
+            f"bench trajectory update failed (non-fatal): "
+            f"{type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
 
 
 if __name__ == "__main__":

@@ -17,6 +17,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
+from ....core.attribution import (
+    CODE_STRATEGY_TAGS,
+    Attribution,
+    improvement_mass,
+    success_mask,
+)
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
 from ....operators.sanitize import sanitize_bounds, validate_bound_handling
@@ -30,6 +36,9 @@ class CoDEState(PyTreeNode):
     population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (3*pop, dim)
+    # per-generation operator attribution (core/attribution.py): the
+    # 3-trials-per-parent axis folded to per-slot best-strategy tags
+    attrib: Attribution = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
 
@@ -57,6 +66,7 @@ class CoDE(Algorithm):
             population=pop,
             fitness=jnp.full((self.pop_size,), jnp.inf),
             trials=jnp.tile(pop, (3, 1)),
+            attrib=Attribution.empty(self.pop_size),
             key=key,
         )
 
@@ -106,8 +116,15 @@ class CoDE(Algorithm):
         best_trial = jnp.take_along_axis(
             trials, best_strat[None, :, None], axis=0
         ).squeeze(0)
-        improved = best_fit < state.fitness
+        improved = success_mask(best_fit, state.fitness)
+        attrib = Attribution(
+            parent_idx=jnp.arange(n, dtype=jnp.int32),
+            op_tag=jnp.asarray(CODE_STRATEGY_TAGS, jnp.int32)[best_strat],
+            success=improved,
+            improvement=improvement_mass(best_fit, state.fitness, improved),
+        )
         return state.replace(
             population=jnp.where(improved[:, None], best_trial, state.population),
             fitness=jnp.where(improved, best_fit, state.fitness),
+            attrib=attrib,
         )

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
+from ....core.attribution import Attribution, de_variant_tag, slot_attribution
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
 from ....operators.sanitize import sanitize_bounds, validate_bound_handling
@@ -37,6 +38,9 @@ class DEState(PyTreeNode):
     population: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     fitness: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     trials: jax.Array = field(sharding=P(POP_AXIS), storage=True)
+    # per-generation operator attribution (core/attribution.py) — read by
+    # LineageMonitor at the post_step boundary, never by the algorithm
+    attrib: Attribution = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
 
@@ -62,6 +66,7 @@ class DE(Algorithm):
         self.n_diff = num_difference_vectors
         self.F = differential_weight
         self.CR = cross_probability
+        self.op_tag = de_variant_tag(base_vector, self.n_diff)
 
     def init(self, key: jax.Array) -> DEState:
         key, k = jax.random.split(key)
@@ -73,6 +78,7 @@ class DE(Algorithm):
             population=pop,
             fitness=jnp.full((self.pop_size,), jnp.inf),
             trials=pop,
+            attrib=Attribution.empty(self.pop_size),
             key=key,
         )
 
@@ -109,8 +115,10 @@ class DE(Algorithm):
         return trials, state.replace(trials=trials, key=key)
 
     def tell(self, state: DEState, fitness: jax.Array) -> DEState:
-        improved = fitness < state.fitness
+        attrib = slot_attribution(fitness, state.fitness, self.op_tag)
+        improved = attrib.success  # == fitness < state.fitness (contract)
         return state.replace(
             population=jnp.where(improved[:, None], state.trials, state.population),
             fitness=jnp.where(improved, fitness, state.fitness),
+            attrib=attrib,
         )

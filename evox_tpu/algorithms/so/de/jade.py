@@ -16,6 +16,14 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
+from ....core.attribution import (
+    OP_DE_CUR_TO_PBEST_1,
+    Attribution,
+    arithmetic_mean_of_successful,
+    lehmer_mean_of_successful,
+    slot_attribution,
+    success_mask,
+)
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
 from ....operators.sanitize import sanitize_bounds, validate_bound_handling
@@ -32,6 +40,9 @@ class JaDEState(PyTreeNode):
     mu_CR: jax.Array = field(sharding=P())
     archive: jax.Array = field(sharding=P(POP_AXIS), storage=True)  # (pop, dim) replaced parents
     archive_size: jax.Array = field(sharding=P())
+    # per-generation operator attribution (core/attribution.py) — the same
+    # success mask that drives the mu_F/mu_CR adaptation
+    attrib: Attribution = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
 
@@ -71,6 +82,7 @@ class JaDE(Algorithm):
             mu_CR=jnp.asarray(0.5),
             archive=pop,
             archive_size=jnp.zeros((), jnp.int32),
+            attrib=Attribution.empty(self.pop_size),
             key=key,
         )
 
@@ -113,14 +125,13 @@ class JaDE(Algorithm):
 
     def tell(self, state: JaDEState, fitness: jax.Array) -> JaDEState:
         key, k_arch = jax.random.split(state.key)
-        improved = fitness < state.fitness
+        improved = success_mask(fitness, state.fitness)
         n_success = jnp.sum(improved)
 
-        # adapt means from successful parameters
-        sF = jnp.where(improved, state.F, 0.0)
-        sCR = jnp.where(improved, state.CR, 0.0)
-        lehmer = jnp.sum(sF**2) / jnp.maximum(jnp.sum(sF), 1e-12)
-        arith = jnp.sum(sCR) / jnp.maximum(n_success, 1)
+        # adapt means from successful parameters (shared contract helpers
+        # — the exact pre-refactor expressions, see core/attribution.py)
+        lehmer = lehmer_mean_of_successful(state.F, improved)
+        arith = arithmetic_mean_of_successful(state.CR, improved, n_success)
         any_s = n_success > 0
         mu_F = jnp.where(any_s, (1 - self.c) * state.mu_F + self.c * lehmer, state.mu_F)
         mu_CR = jnp.where(any_s, (1 - self.c) * state.mu_CR + self.c * arith, state.mu_CR)
@@ -141,5 +152,6 @@ class JaDE(Algorithm):
             mu_CR=mu_CR,
             archive=archive,
             archive_size=archive_size,
+            attrib=slot_attribution(fitness, state.fitness, OP_DE_CUR_TO_PBEST_1),
             key=key,
         )

@@ -17,6 +17,13 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
+from ....core.attribution import (
+    SADE_STRATEGY_TAGS,
+    Attribution,
+    improvement_mass,
+    strategy_success_counts,
+    success_mask,
+)
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
 from ....operators.sanitize import sanitize_bounds, validate_bound_handling
@@ -36,6 +43,9 @@ class SaDEState(PyTreeNode):
     failure_mem: jax.Array = field(sharding=P())
     CRm: jax.Array = field(sharding=P())  # (4,) per-strategy CR memory
     gen: jax.Array = field(sharding=P())
+    # per-generation operator attribution (core/attribution.py) — the same
+    # success mask that drives strategy adaptation, published for monitors
+    attrib: Attribution = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
 
@@ -72,6 +82,7 @@ class SaDE(Algorithm):
             failure_mem=jnp.zeros((self.LP, _N_STRATEGY)),
             CRm=jnp.full((_N_STRATEGY,), 0.5),
             gen=jnp.zeros((), jnp.int32),
+            attrib=Attribution.empty(self.pop_size),
             key=key,
         )
 
@@ -120,10 +131,10 @@ class SaDE(Algorithm):
         )
 
     def tell(self, state: SaDEState, fitness: jax.Array) -> SaDEState:
-        improved = fitness < state.fitness
-        onehot = jax.nn.one_hot(state.strategy, _N_STRATEGY)
-        succ = (improved[:, None] * onehot).sum(axis=0)
-        fail = ((~improved)[:, None] * onehot).sum(axis=0)
+        improved = success_mask(fitness, state.fitness)
+        succ, fail, onehot = strategy_success_counts(
+            improved, state.strategy, _N_STRATEGY
+        )
         slot = state.gen % self.LP
         success_mem = state.success_mem.at[slot].set(succ)
         failure_mem = state.failure_mem.at[slot].set(fail)
@@ -138,6 +149,12 @@ class SaDE(Algorithm):
         mean_cr = jnp.sum(succ_cr, axis=0) / jnp.maximum(succ, 1.0)
         CRm = jnp.where(warmed & (succ > 0), mean_cr, state.CRm)
 
+        attrib = Attribution(
+            parent_idx=jnp.arange(self.pop_size, dtype=jnp.int32),
+            op_tag=jnp.asarray(SADE_STRATEGY_TAGS, jnp.int32)[state.strategy],
+            success=improved,
+            improvement=improvement_mass(fitness, state.fitness, improved),
+        )
         return state.replace(
             population=jnp.where(improved[:, None], state.trials, state.population),
             fitness=jnp.where(improved, fitness, state.fitness),
@@ -146,4 +163,5 @@ class SaDE(Algorithm):
             failure_mem=failure_mem,
             CRm=CRm,
             gen=state.gen + 1,
+            attrib=attrib,
         )

@@ -15,6 +15,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ....core.algorithm import Algorithm
+from ....core.attribution import (
+    OP_DE_CUR_TO_PBEST_1,
+    Attribution,
+    slot_attribution,
+    success_mask,
+)
 from ....core.distributed import POP_AXIS
 from ....core.struct import PyTreeNode, field
 from .de import select_rand_indices
@@ -31,6 +37,8 @@ class SHADEState(PyTreeNode):
     mem_pos: jax.Array = field(sharding=P())
     archive: jax.Array = field(sharding=P(POP_AXIS), storage=True)
     archive_size: jax.Array = field(sharding=P())
+    # per-generation operator attribution (core/attribution.py)
+    attrib: Attribution = field(sharding=P())
     key: jax.Array = field(sharding=P())
 
 
@@ -59,6 +67,7 @@ class SHADE(Algorithm):
             mem_pos=jnp.zeros((), jnp.int32),
             archive=pop,
             archive_size=jnp.zeros((), jnp.int32),
+            attrib=Attribution.empty(self.pop_size),
             key=key,
         )
 
@@ -103,7 +112,7 @@ class SHADE(Algorithm):
 
     def tell(self, state: SHADEState, fitness: jax.Array) -> SHADEState:
         key, k_arch = jax.random.split(state.key)
-        improved = fitness < state.fitness
+        improved = success_mask(fitness, state.fitness)
         n_success = jnp.sum(improved)
         # weighted by fitness improvement (SHADE eq. 7-9)
         w_raw = jnp.where(improved, state.fitness - fitness, 0.0)
@@ -135,5 +144,6 @@ class SHADE(Algorithm):
             mem_pos=mem_pos,
             archive=archive,
             archive_size=archive_size,
+            attrib=slot_attribution(fitness, state.fitness, OP_DE_CUR_TO_PBEST_1),
             key=key,
         )

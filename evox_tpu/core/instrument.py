@@ -690,10 +690,16 @@ def run_report(
     # census, ledger event counts, tenant accounting with the
     # exactly-once admission audit, and the steal/autoscale event
     # streams) — validated when present, incl. the ledger↔counter
-    # coherence and empty-duplicate-admissions rules.
+    # coherence and empty-duplicate-admissions rules. v13 adds the
+    # optional `search` section (ISSUE 19, monitors/lineage.py
+    # LineageMonitor: the operator-attribution credit ledger, best-
+    # ancestry traceback, restart-epoch counter, per-generation
+    # best/delta trajectory, and the MO front-size/churn rings) —
+    # validated when present, incl. the successes≤attempts ledger rule,
+    # ancestry-indices-in-range, and churn non-negativity.
     report: dict = {
-        "schema": "evox_tpu.run_report/v12",
-        "schema_version": 12,
+        "schema": "evox_tpu.run_report/v13",
+        "schema_version": 13,
     }
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
@@ -741,6 +747,21 @@ def run_report(
                 report["surrogate"] = workflow.surrogate_report(state)
             except Exception as e:  # decoration must never sink the report
                 report["surrogate"] = {"error": f"{type(e).__name__}: {e}"}
+        # search-dynamics lineage (schema v13, monitors/lineage.py): the
+        # first attached monitor exposing `search_report` contributes the
+        # top-level `search` section — attribution ledger, best-ancestry
+        # traceback, epoch counter, trajectory window (duck-typed: core
+        # never imports the monitors package)
+        if mstates is not None:
+            for i, mon in enumerate(getattr(workflow, "monitors", ())):
+                if hasattr(mon, "search_report"):
+                    try:
+                        report["search"] = mon.search_report(mstates[i])
+                    except Exception as e:  # must never sink the report
+                        report["search"] = {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
+                    break
     summary = recorder.summary() if recorder is not None else None
     if summary is not None:
         report["dispatch"] = summary

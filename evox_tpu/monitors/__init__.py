@@ -4,6 +4,7 @@ from .evoxvis_monitor import EvoXVisMonitor
 from .checkpoint_monitor import CheckpointMonitor
 from .profiler import StepTimerMonitor, trace as profiler_trace
 from .telemetry import TelemetryMonitor, TelemetryState
+from .lineage import LineageMonitor, LineageState
 from .common import backend_supports_callbacks
 from . import profiler
 
@@ -16,6 +17,8 @@ __all__ = [
     "StepTimerMonitor",
     "TelemetryMonitor",
     "TelemetryState",
+    "LineageMonitor",
+    "LineageState",
     "backend_supports_callbacks",
     "profiler_trace",
     "profiler",

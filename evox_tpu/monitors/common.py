@@ -1,9 +1,23 @@
-"""Shared monitor plumbing."""
+"""Shared monitor plumbing: host-callback probes and the fixed-capacity
+device-ring discipline.
+
+The ring helpers are the one implementation behind every on-device
+history buffer in the stack — EvalMonitor's device history,
+TelemetryMonitor's trajectory rings, LineageMonitor's lineage rings, the
+SurrogateArchive, and the surrogate fallback-event log. All share the
+same law: a ``(K, ...)`` buffer plus a monotone ``count``; the write slot
+is ``count % K``; host readback is chronological over the last
+``min(count, K)`` writes. Keeping them on one helper keeps the discipline
+identical (fixed shapes, no retrace as counts grow, axon-safe — zero host
+callbacks in the write path).
+"""
 
 from __future__ import annotations
 
 import jax
 from jax.sharding import SingleDeviceSharding
+
+from ..utils.ring import ring_scatter_indices, ring_slots, ring_write  # noqa: F401
 
 
 def host0_sharding() -> SingleDeviceSharding:
@@ -30,3 +44,9 @@ def backend_supports_callbacks() -> bool:
     except Exception:  # pragma: no cover - backend probing must never fail
         return True
     return not any(m in version for m in CALLBACK_LESS_MARKERS)
+
+
+# ---------------------------------------------------------- device rings
+# The implementation lives in utils/ring.py (the bottom layer, so
+# operators — e.g. the SurrogateArchive — can share it without importing
+# monitors); monitor code imports the discipline from here.

@@ -20,7 +20,7 @@ from jax.experimental import io_callback
 
 from ..core.monitor import Monitor
 from jax.sharding import PartitionSpec as P
-from .common import backend_supports_callbacks, host0_sharding
+from .common import backend_supports_callbacks, host0_sharding, ring_slots, ring_write
 from ..core.struct import PyTreeNode, field
 from ..operators.selection.non_dominate import (
     crowding_distance,
@@ -151,20 +151,19 @@ class EvalMonitor(Monitor):
             ((0, width - n),) + ((0, 0),) * (fitness.ndim - 1),
             constant_values=jnp.inf,
         )
-        slot = count % K
-        hist_fit = jax.lax.dynamic_update_index_in_dim(hist_fit, row, slot, 0)
+        # shared ring discipline (monitors/common.py): slot = count % K
+        hist_fit = ring_write(hist_fit, row, count)
         if hist_sol is not None:
             hist_sol = jax.tree.map(
-                lambda buf, c: jax.lax.dynamic_update_index_in_dim(
+                lambda buf, c: ring_write(
                     buf,
                     jnp.pad(c, ((0, width - n),) + ((0, 0),) * (c.ndim - 1)),
-                    slot,
-                    0,
+                    count,
                 ),
                 hist_sol,
                 cand,
             )
-        hist_len = hist_len.at[slot].set(n)
+        hist_len = ring_write(hist_len, n, count)
         return dict(
             hist_fit=hist_fit,
             hist_sol=hist_sol,
@@ -305,9 +304,7 @@ class EvalMonitor(Monitor):
 
     # ----------------------------------------- device-history ring getters
     def _ring_slots(self, mstate: EvalMonitorState):
-        count, K = int(mstate.hist_count), self.history_capacity
-        n = min(count, K)
-        return [(i % K) for i in range(count - n, count)]
+        return ring_slots(mstate.hist_count, self.history_capacity)
 
     def get_device_fitness_history(self, mstate: EvalMonitorState) -> list:
         """The last ``min(count, history_capacity)`` generations' fitness,

@@ -1,0 +1,525 @@
+"""LineageMonitor — on-device search-dynamics rings: per-slot lineage,
+operator attribution, and convergence forensics (ISSUE 19).
+
+Answers the questions the other observability planes cannot: *which slot
+did the current best descend from, which operator earned each
+improvement, and why did this run stall?* Everything is the
+EvalMonitor/TelemetryMonitor ring discipline — fixed-shape ``(K, ...)``
+buffers written at ``count % K`` (utils/ring.py), zero host callbacks
+(pinned by tests/test_no_host_callbacks.py), so it runs unchanged in step
+loops, the fused ``run()`` fori_loop, ``run_host_pipelined``, the
+8-device mesh, sharded populations, and vmapped VectorizedWorkflow fleets
+(per-tenant rings and ancestry).
+
+Per generation it records:
+
+- the **parent-index map** ``(K, width)``: which slot each survivor
+  descended from. Algorithms publishing the ``core/attribution.py``
+  contract (the DE family) supply it exactly; everything else is tagged
+  at the selection boundary (slot identity — see below).
+- a per-candidate **operator tag** ``(K, width)`` from the shared
+  vocabulary (``OP_NAMES``), plus a cumulative per-operator credit
+  ledger: attempts, successes, improvement mass.
+- per-slot **age** (generations since last improvement) and
+  **improvement counters**.
+- the per-generation **best-so-far delta** and best slot/fitness.
+- a restart/exploit **epoch counter**: a GuardedAlgorithm's on-device
+  ``restarts`` counter is mirrored (the TelemetryMonitor discipline) and
+  external drivers (PBT exploit surgery) can call :meth:`bump_epoch`;
+  every ring row records its epoch so ``best_ancestry()`` never walks an
+  edge across a restart — cross-epoch "descent" would be fiction.
+- multi-objective runs (``num_objectives > 1``) additionally get
+  **front-size** and **non-dominated-churn** rings: the rank-0 front of
+  each generation's batch (operators/selection/non_dominate.py) and the
+  masked IGD (metrics/igd.py) between consecutive fronts — churn near 0
+  with a full front means the front has genuinely settled.
+
+Attribution sources, in order:
+
+1. ``wf_state.algo`` (unwrapping guardrail ``.inner``) exposing an
+   ``attrib`` field — the exact bookkeeping the algorithm's own
+   adaptation used (bit-identical contract, core/attribution.py).
+2. Selection-boundary fallback for everything else: parent = slot
+   identity, operator = ``default_op`` (constructor; e.g. ``"sample"``
+   for ES/CMA, ``"velocity"`` for PSO, ``"crossover"`` for MO GAs),
+   success = per-slot fitness improvement over the previous generation.
+   Honest but coarser: replacement-based algorithms (ES) re-sample every
+   slot, so "age" there reads as positional stagnation, not individual
+   survival.
+
+``lineage=None`` (simply not attaching the monitor) is an exact no-op on
+every other state in the workflow — the PR-16 digest law, asserted by
+tests/test_lineage.py.
+
+No reference analog (PARITY row 63); design sources are the per-member
+exploit/explore provenance planes of PBT-style systems (PAPERS.md: "Fast
+Population-Based RL on a Single Machine", Fiber).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..core.attribution import (
+    N_OPS,
+    OP_INIT,
+    OP_NAMES,
+    Attribution,
+    find_attribution,
+    improvement_mass,
+    op_credit,
+    success_mask,
+)
+from ..core.instrument import sanitize_json
+from ..core.monitor import Monitor
+from ..core.struct import PyTreeNode, field
+from ..metrics.igd import masked_igd
+from .common import ring_slots, ring_write
+
+
+class LineageState(PyTreeNode):
+    # cumulative scalars (always materialized; int32 counters)
+    count: jax.Array = field(sharding=P())  # () generations recorded
+    epoch_extra: jax.Array = field(sharding=P())  # () manual bump_epoch count
+    restarts_seen: jax.Array = field(sharding=P())  # () guardrail mirror
+    best_key: jax.Array = field(sharding=P())  # () best-so-far, internal key
+    # per-operator credit ledger, cumulative
+    ledger_attempts: jax.Array = field(sharding=P())  # (N_OPS,) i32
+    ledger_success: jax.Array = field(sharding=P())  # (N_OPS,) i32
+    ledger_improvement: jax.Array = field(sharding=P())  # (N_OPS,) f32
+    # width-dependent buffers, materialized by the first post_eval (the
+    # EvalMonitor lazy-ring pattern; width = first batch's slot count)
+    cur_fit: Optional[jax.Array] = field(sharding=P(), default=None)  # (w,) stash
+    prev_fit: Optional[jax.Array] = field(sharding=P(), default=None)  # (w,)
+    age: Optional[jax.Array] = field(sharding=P(), default=None)  # (w,) i32
+    improvements: Optional[jax.Array] = field(sharding=P(), default=None)  # (w,) i32
+    ring_parent: Optional[jax.Array] = field(sharding=P(), default=None)  # (K, w) i32
+    ring_op: Optional[jax.Array] = field(sharding=P(), default=None)  # (K, w) i32
+    ring_best_slot: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) i32
+    ring_best_fit: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) f32
+    ring_delta: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) f32
+    ring_epoch: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) i32
+    # multi-objective extras (None when num_objectives == 1)
+    cur_front: Optional[jax.Array] = field(sharding=P(), default=None)  # (w, m) stash
+    cur_front_mask: Optional[jax.Array] = field(sharding=P(), default=None)  # (w,)
+    prev_front: Optional[jax.Array] = field(sharding=P(), default=None)  # (w, m)
+    prev_front_mask: Optional[jax.Array] = field(sharding=P(), default=None)  # (w,)
+    ring_front_size: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) i32
+    ring_churn: Optional[jax.Array] = field(sharding=P(), default=None)  # (K,) f32
+
+
+class LineageMonitor(Monitor):
+    """On-device lineage rings + operator-attribution ledger.
+
+    Args:
+        history_capacity: ring size K — the last K generations' parent
+            maps, operator tags, best slot/fitness/delta and epoch are
+            kept on device (older slots overwritten, ring semantics).
+        num_objectives: fitness arity. ``m > 1`` adds the front-size and
+            non-dominated-churn rings (an O(batch²) dominance pass per
+            generation — size the batch accordingly).
+        default_op: vocabulary name (``core.attribution.OP_NAMES``) used
+            to tag candidates of algorithms that do not publish the
+            attribution contract — ``"sample"`` (ES/CMA default),
+            ``"velocity"`` (PSO), ``"crossover"``/``"mutation"`` (GAs).
+
+    Fitness quantities are stored in the algorithm-internal minimize key;
+    ``report()``/``search_report()`` convert back to the user convention
+    for single-objective runs.
+    """
+
+    def __init__(
+        self,
+        history_capacity: int = 64,
+        num_objectives: int = 1,
+        default_op: str = "sample",
+    ):
+        if history_capacity < 1:
+            raise ValueError(
+                f"history_capacity must be >= 1, got {history_capacity}"
+            )
+        if num_objectives < 1:
+            raise ValueError(
+                f"num_objectives must be >= 1, got {num_objectives}"
+            )
+        if default_op not in OP_NAMES:
+            raise ValueError(
+                f"default_op {default_op!r} is not in the attribution "
+                f"vocabulary {OP_NAMES}"
+            )
+        self.capacity = history_capacity
+        self.num_objectives = num_objectives
+        self.default_op = OP_NAMES.index(default_op)
+        self.opt_direction = jnp.ones((1,), dtype=jnp.float32)
+
+    def hooks(self):
+        return ("post_eval", "post_step")
+
+    def init(self, key: Optional[jax.Array] = None) -> LineageState:
+        i32 = lambda: jnp.zeros((), dtype=jnp.int32)  # noqa: E731
+        return LineageState(
+            count=i32(),
+            epoch_extra=i32(),
+            restarts_seen=i32(),
+            best_key=jnp.asarray(jnp.inf, jnp.float32),
+            ledger_attempts=jnp.zeros((N_OPS,), jnp.int32),
+            ledger_success=jnp.zeros((N_OPS,), jnp.int32),
+            ledger_improvement=jnp.zeros((N_OPS,), jnp.float32),
+        )
+
+    # ----------------------------------------------------------- internals
+    def _scalar_key(self, fitness: jax.Array) -> jax.Array:
+        """Per-candidate scalar minimize key. SO: fitness flipped to the
+        internal direction. MO: the mean internal objective — used ONLY
+        to pick a representative best slot / delta for the lineage rings
+        (front quality lives in the churn/front-size rings)."""
+        if self.num_objectives == 1:
+            return (fitness * self.opt_direction[0]).astype(jnp.float32)
+        key = fitness * self.opt_direction
+        return jnp.mean(key, axis=-1).astype(jnp.float32)
+
+    def _fold_width(self, key_fit: jax.Array, width: int) -> jax.Array:
+        """Fold a wider-than-width batch onto the slot axis. CoDE
+        evaluates ``3 * pop`` trials laid out ``reshape(3, pop)`` (its
+        own tell layout, code.py:102); the per-slot best trial is the one
+        that competes at that slot. Narrower batches inf-pad."""
+        w = key_fit.shape[0]
+        if w == width:
+            return key_fit
+        if w % width == 0:
+            return key_fit.reshape(-1, width).min(axis=0)
+        if w < width:
+            return jnp.pad(key_fit, (0, width - w), constant_values=jnp.inf)
+        raise ValueError(
+            f"lineage ring was sized by the first generation (width "
+            f"{width}); cannot fold a batch of {w} (not a multiple). "
+            "Evaluate the widest batch first or use a fresh monitor."
+        )
+
+    # ---------------------------------------------------------------- hooks
+    def post_eval(
+        self, mstate: LineageState, cand: Any, fitness: jax.Array
+    ) -> LineageState:
+        m = self.num_objectives
+        if m == 1 and fitness.ndim != 1:
+            raise ValueError(
+                f"LineageMonitor(num_objectives=1) got fitness of shape "
+                f"{fitness.shape}; pass num_objectives={fitness.shape[-1]} "
+                "for multi-objective runs"
+            )
+        if m > 1 and (fitness.ndim != 2 or fitness.shape[-1] != m):
+            raise ValueError(
+                f"LineageMonitor(num_objectives={m}) got fitness of shape "
+                f"{fitness.shape}"
+            )
+        key_fit = self._scalar_key(fitness)
+        K = self.capacity
+
+        if mstate.cur_fit is None:
+            # first batch sizes the slot axis (EvalMonitor discipline)
+            width = key_fit.shape[0]
+            mstate = mstate.replace(
+                cur_fit=key_fit,
+                prev_fit=jnp.full((width,), jnp.inf, jnp.float32),
+                age=jnp.zeros((width,), jnp.int32),
+                improvements=jnp.zeros((width,), jnp.int32),
+                ring_parent=jnp.zeros((K, width), jnp.int32),
+                ring_op=jnp.zeros((K, width), jnp.int32),
+                ring_best_slot=jnp.zeros((K,), jnp.int32),
+                ring_best_fit=jnp.full((K,), jnp.inf, jnp.float32),
+                ring_delta=jnp.zeros((K,), jnp.float32),
+                ring_epoch=jnp.zeros((K,), jnp.int32),
+            )
+            if m > 1:
+                mstate = mstate.replace(
+                    cur_front=jnp.zeros((width, m), jnp.float32),
+                    cur_front_mask=jnp.zeros((width,), bool),
+                    prev_front=jnp.zeros((width, m), jnp.float32),
+                    prev_front_mask=jnp.zeros((width,), bool),
+                    ring_front_size=jnp.zeros((K,), jnp.int32),
+                    ring_churn=jnp.zeros((K,), jnp.float32),
+                )
+        else:
+            width = mstate.cur_fit.shape[0]
+            mstate = mstate.replace(cur_fit=self._fold_width(key_fit, width))
+
+        if m > 1:
+            if fitness.shape[0] != mstate.cur_front.shape[0]:
+                raise ValueError(
+                    "LineageMonitor MO rings need a constant batch width "
+                    f"(sized {mstate.cur_front.shape[0]} by the first "
+                    f"generation, got {fitness.shape[0]})"
+                )
+            # rank-0 front of this batch, internal minimize convention.
+            # Deferred import: operators -> core only; monitors sit above.
+            from ..operators.selection.non_dominate import non_dominated_sort
+
+            key_obj = (fitness * self.opt_direction).astype(jnp.float32)
+            finite = jnp.all(jnp.isfinite(key_obj), axis=-1)
+            rank = non_dominated_sort(
+                jnp.where(finite[:, None], key_obj, jnp.inf), until=1
+            )
+            front_mask = (rank == 0) & finite
+            mstate = mstate.replace(
+                cur_front=jnp.where(front_mask[:, None], key_obj, 0.0),
+                cur_front_mask=front_mask,
+            )
+        return mstate
+
+    def post_step(self, mstate: LineageState, wf_state: Any) -> LineageState:
+        if mstate.cur_fit is None:  # post_eval never ran: nothing to record
+            return mstate
+        width = mstate.cur_fit.shape[0]
+        cur, prev = mstate.cur_fit, mstate.prev_fit
+
+        astate = getattr(wf_state, "algo", None)
+        attrib = find_attribution(astate)
+        if attrib is not None and attrib.parent_idx.shape[0] != width:
+            attrib = None  # container/fleet reshaping: fall back honestly
+        if attrib is None:
+            # selection-boundary tagging: parent = slot identity, success
+            # = this slot's fitness improved over the previous generation,
+            # replacement semantics (the whole batch becomes the new
+            # per-slot fitness — ES/PSO/MO discipline)
+            succ = success_mask(cur, prev)
+            tag = jnp.where(
+                mstate.count == 0, jnp.int32(OP_INIT), jnp.int32(self.default_op)
+            )
+            attrib = Attribution(
+                parent_idx=jnp.arange(width, dtype=jnp.int32),
+                op_tag=jnp.broadcast_to(tag, (width,)),
+                success=succ,
+                improvement=improvement_mass(cur, prev, succ),
+            )
+            new_fit = cur
+        else:
+            # contract attribution: greedy slot descent — the slot keeps
+            # its incumbent unless the candidate succeeded
+            new_fit = jnp.where(attrib.success, cur, prev)
+
+        # epoch: guardrail restarts mirrored structurally (TelemetryMonitor
+        # discipline) + manual bump_epoch() increments
+        restarts = mstate.restarts_seen
+        if hasattr(astate, "restarts"):
+            restarts = jnp.asarray(astate.restarts, jnp.int32)
+        epoch = restarts + mstate.epoch_extra
+
+        # per-slot counters
+        age = jnp.where(attrib.success, 0, mstate.age + 1)
+        improvements = mstate.improvements + attrib.success.astype(jnp.int32)
+
+        # credit ledger
+        attempts, successes, improvement = op_credit(attrib, N_OPS)
+        ledger_attempts = mstate.ledger_attempts + attempts
+        ledger_success = mstate.ledger_success + successes
+        ledger_improvement = mstate.ledger_improvement + improvement
+
+        # best-so-far delta (internal key; monotone, so delta >= 0)
+        gen_best = jnp.min(new_fit)
+        best_slot = jnp.argmin(new_fit).astype(jnp.int32)
+        new_best = jnp.minimum(mstate.best_key, gen_best)
+        delta = jnp.where(
+            jnp.isfinite(mstate.best_key),
+            jnp.maximum(mstate.best_key - new_best, 0.0),
+            0.0,
+        )
+
+        count = mstate.count
+        mstate = mstate.replace(
+            count=count + 1,
+            restarts_seen=restarts,
+            best_key=new_best,
+            ledger_attempts=ledger_attempts,
+            ledger_success=ledger_success,
+            ledger_improvement=ledger_improvement,
+            prev_fit=new_fit,
+            age=age,
+            improvements=improvements,
+            ring_parent=ring_write(mstate.ring_parent, attrib.parent_idx, count),
+            ring_op=ring_write(mstate.ring_op, attrib.op_tag, count),
+            ring_best_slot=ring_write(mstate.ring_best_slot, best_slot, count),
+            ring_best_fit=ring_write(mstate.ring_best_fit, gen_best, count),
+            ring_delta=ring_write(mstate.ring_delta, delta, count),
+            ring_epoch=ring_write(mstate.ring_epoch, epoch, count),
+        )
+        if self.num_objectives > 1:
+            churn = masked_igd(
+                mstate.cur_front,
+                mstate.cur_front_mask,
+                mstate.prev_front,
+                mstate.prev_front_mask,
+            )
+            front_size = jnp.sum(mstate.cur_front_mask).astype(jnp.int32)
+            mstate = mstate.replace(
+                prev_front=mstate.cur_front,
+                prev_front_mask=mstate.cur_front_mask,
+                ring_front_size=ring_write(
+                    mstate.ring_front_size, front_size, count
+                ),
+                ring_churn=ring_write(mstate.ring_churn, churn, count),
+            )
+        return mstate
+
+    # ------------------------------------------------------------- epoching
+    def bump_epoch(self, mstate: LineageState) -> LineageState:
+        """Advance the exploit epoch (jit-safe). External drivers that
+        perform population surgery between steps — PBT exploit/explore,
+        island migrations, manual recenters — call this so subsequent
+        ring rows are never read as descent from pre-surgery slots."""
+        return mstate.replace(epoch_extra=mstate.epoch_extra + 1)
+
+    # --------------------------------------------------------------- getters
+    def _chronology(self, mstate: LineageState):
+        """Host-side (generation, slot) pairs, oldest first."""
+        slots = ring_slots(mstate.count, self.capacity)
+        count = int(mstate.count)
+        gens = list(range(count - len(slots) + 1, count + 1))
+        return gens, slots
+
+    def best_ancestry(self, mstate: LineageState) -> list:
+        """Trace the current best individual back through the recorded
+        window: newest entry first, each ``{generation, slot, parent,
+        op, epoch}``. The walk stops at a ring-window edge or an epoch
+        boundary (restart/exploit) — an edge across epochs is not
+        descent. Host-side, eager."""
+        if mstate.ring_best_slot is None or int(mstate.count) == 0:
+            return []
+        gens, slots = self._chronology(mstate)
+        ring_parent = np.asarray(jax.device_get(mstate.ring_parent))
+        ring_op = np.asarray(jax.device_get(mstate.ring_op))
+        ring_best = np.asarray(jax.device_get(mstate.ring_best_slot))
+        ring_epoch = np.asarray(jax.device_get(mstate.ring_epoch))
+        chain = []
+        slot = int(ring_best[slots[-1]])
+        epoch = int(ring_epoch[slots[-1]])
+        for gen, s in zip(reversed(gens), reversed(slots)):
+            if int(ring_epoch[s]) != epoch:
+                break  # restart/exploit boundary: lineage ends here
+            parent = int(ring_parent[s][slot])
+            chain.append(
+                {
+                    "generation": gen,
+                    "slot": slot,
+                    "parent": parent,
+                    "op": OP_NAMES[int(ring_op[s][slot])],
+                    "epoch": int(ring_epoch[s]),
+                }
+            )
+            slot = parent
+        return chain
+
+    def ledger(self, mstate: LineageState) -> dict:
+        """The per-operator credit table (host-side): only operators with
+        at least one attempt appear."""
+        attempts = np.asarray(jax.device_get(mstate.ledger_attempts))
+        success = np.asarray(jax.device_get(mstate.ledger_success))
+        improvement = np.asarray(jax.device_get(mstate.ledger_improvement))
+        out = {}
+        for i, name in enumerate(OP_NAMES):
+            if int(attempts[i]) > 0:
+                out[name] = {
+                    "attempts": int(attempts[i]),
+                    "successes": int(success[i]),
+                    "improvement": float(improvement[i]),
+                }
+        return out
+
+    def get_trajectory(self, mstate: LineageState) -> dict:
+        """Chronological per-generation window: best slot, best fitness
+        (user convention for SO), best-so-far delta, epoch — plus front
+        size and churn for MO."""
+        if mstate.ring_best_slot is None:
+            return {
+                "generation": [],
+                "best_slot": [],
+                "best_fitness": [],
+                "delta": [],
+                "epoch": [],
+            }
+        gens, slots = self._chronology(mstate)
+        direction = (
+            float(self.opt_direction[0]) if self.num_objectives == 1 else 1.0
+        )
+        best_fit = np.asarray(jax.device_get(mstate.ring_best_fit))
+        out = {
+            "generation": gens,
+            "best_slot": [int(np.asarray(mstate.ring_best_slot)[s]) for s in slots],
+            "best_fitness": [float(best_fit[s] * direction) for s in slots],
+            "delta": [float(np.asarray(mstate.ring_delta)[s]) for s in slots],
+            "epoch": [int(np.asarray(mstate.ring_epoch)[s]) for s in slots],
+        }
+        if self.num_objectives > 1:
+            out["front_size"] = [
+                int(np.asarray(mstate.ring_front_size)[s]) for s in slots
+            ]
+            out["churn"] = [
+                float(np.asarray(mstate.ring_churn)[s]) for s in slots
+            ]
+        return out
+
+    def counter_tracks(self, mstate: LineageState) -> dict:
+        """Generation-indexed counter samples for the Chrome-trace
+        exporter (core/instrument.py ``write_chrome_trace``):
+        ``{track_name: [(generation, value), ...]}``."""
+        traj = self.get_trajectory(mstate)
+        gens = traj["generation"]
+        tracks = {
+            "search/best_fitness": list(zip(gens, traj["best_fitness"])),
+            "search/delta": list(zip(gens, traj["delta"])),
+            "search/epoch": list(zip(gens, traj["epoch"])),
+        }
+        if self.num_objectives > 1:
+            tracks["search/front_size"] = list(zip(gens, traj["front_size"]))
+            tracks["search/churn"] = list(zip(gens, traj["churn"]))
+        return tracks
+
+    def fingerprint(self, mstate: LineageState) -> str:
+        """SHA-256 over the exact bytes of every lineage field — the
+        bit-identity witness used by the fused/pipelined equivalence
+        laws (same discipline as TelemetryMonitor.fingerprint)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for path, leaf in jax.tree_util.tree_flatten_with_path(mstate)[0]:
+            h.update(jax.tree_util.keystr(path).encode())
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        return h.hexdigest()
+
+    def search_report(self, mstate: LineageState) -> dict:
+        """The ``search`` section of ``run_report()`` (schema v13):
+        strictly JSON-serializable, validated by tools/check_report.py."""
+        width = (
+            int(mstate.cur_fit.shape[0]) if mstate.cur_fit is not None else 0
+        )
+        age = (
+            np.asarray(jax.device_get(mstate.age))
+            if mstate.age is not None
+            else np.zeros((0,), np.int32)
+        )
+        report = {
+            "enabled": True,
+            "generations": int(mstate.count),
+            "capacity": self.capacity,
+            "width": width,
+            "num_objectives": self.num_objectives,
+            "epoch": int(mstate.restarts_seen) + int(mstate.epoch_extra),
+            "restarts": int(mstate.restarts_seen),
+            "ledger": self.ledger(mstate),
+            "ancestry": self.best_ancestry(mstate),
+            "age": {
+                "max": int(age.max()) if age.size else 0,
+                "mean": float(age.mean()) if age.size else 0.0,
+            },
+            "trajectory": self.get_trajectory(mstate),
+        }
+        return sanitize_json(report)
+
+    def report(self, mstate: LineageState) -> dict:
+        """Monitor-report protocol (run_report telemetry list, per-tenant
+        fleet reports): the search report under the standard keys."""
+        return self.search_report(mstate)

@@ -34,6 +34,7 @@ from ..core.instrument import sanitize_json
 from ..core.monitor import Monitor
 from jax.sharding import PartitionSpec as P
 from ..core.struct import PyTreeNode, field
+from .common import ring_slots, ring_write
 
 
 class TelemetryState(PyTreeNode):
@@ -203,11 +204,8 @@ class TelemetryMonitor(Monitor):
         )
         stagnation = jnp.where(improved, 0, mstate.stagnation + 1)
 
-        # -- ring update ----------------------------------------------------
-        slot = mstate.generations % self.capacity
-        upd = lambda buf, row: jax.lax.dynamic_update_index_in_dim(  # noqa: E731
-            buf, row.astype(buf.dtype), slot, 0
-        )
+        # -- ring update (shared discipline: monitors/common.py) ------------
+        upd = lambda buf, row: ring_write(buf, row, mstate.generations)  # noqa: E731
         return TelemetryState(
             generations=generations,
             evals=mstate.evals + jnp.int32(fitness.shape[0]),
@@ -261,9 +259,7 @@ class TelemetryMonitor(Monitor):
         return mstate.best_key * direction
 
     def _ring_slots(self, mstate: TelemetryState):
-        count, K = int(mstate.generations), self.capacity
-        n = min(count, K)
-        return [(i % K) for i in range(count - n, count)]
+        return ring_slots(mstate.generations, self.capacity)
 
     def get_trajectory(self, mstate: TelemetryState) -> dict:
         """Chronological per-generation history of the last

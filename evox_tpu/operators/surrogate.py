@@ -54,6 +54,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.distributed import POP_AXIS
 from ..core.struct import PyTreeNode, field
+from ..utils.ring import ring_scatter_indices
 
 __all__ = [
     "ArchiveState",
@@ -129,15 +130,13 @@ class SurrogateArchive:
                 "collide with itself inside the ring — size the archive "
                 "to at least the widest evaluated batch"
             )
-        mask = mask.astype(jnp.int32)
-        offsets = jnp.cumsum(mask) - 1  # position among accepted rows
-        idx = jnp.where(
-            mask > 0, (astate.count + offsets) % self.capacity, self.capacity
-        )
+        idx, count = ring_scatter_indices(
+            astate.count, mask, self.capacity
+        )  # shared ring discipline (utils/ring.py)
         return ArchiveState(
             x=astate.x.at[idx].set(x.astype(astate.x.dtype), mode="drop"),
             y=astate.y.at[idx].set(y.astype(astate.y.dtype), mode="drop"),
-            count=astate.count + jnp.sum(mask),
+            count=count,
         )
 
     def fill(self, astate: ArchiveState) -> jax.Array:

@@ -300,6 +300,34 @@ class FlightRecorder:
             "deadline_misses": int(reg.value("slo.deadline_misses")),
         }
 
+    def record_search(self, search: Dict[str, Any]) -> None:
+        """Publish a LineageMonitor search report (monitors/lineage.py,
+        the run_report schema-v13 ``search`` section) into the
+        ``search.*`` gauge namespace — called at a dispatch boundary
+        with ``monitor.search_report(mstate)``, the same host-side
+        cadence as :meth:`sample`. Gauges carry absolute values (the
+        monitor's on-device counters are the source of truth; re-publish
+        after a crash restore and the plane converges like everything
+        else): ``search.generations`` / ``search.epoch`` /
+        ``search.restarts``, the newest window's ``search.best_fitness``
+        / ``search.delta`` (and ``search.front_size`` /
+        ``search.churn`` for MO runs), and the per-operator credit table
+        as ``search.ledger.<op>.attempts|successes|improvement``."""
+        if not isinstance(search, dict) or not search.get("enabled"):
+            return
+        for key in ("generations", "epoch", "restarts", "width"):
+            if isinstance(search.get(key), (int, float)):
+                self.set(f"search.{key}", float(search[key]))
+        for op, row in (search.get("ledger") or {}).items():
+            for key in ("attempts", "successes", "improvement"):
+                if isinstance(row.get(key), (int, float)):
+                    self.set(f"search.ledger.{op}.{key}", float(row[key]))
+        traj = search.get("trajectory") or {}
+        for key in ("best_fitness", "delta", "front_size", "churn"):
+            col = traj.get(key)
+            if isinstance(col, list) and col:
+                self.set(f"search.{key}", float(col[-1]))
+
     def report(self) -> dict:
         """The ``metrics`` section of ``run_report()`` (schema v11,
         validated by tools/check_report.py)."""

@@ -464,11 +464,22 @@ class StdWorkflow:
         expect_like = fallback_state
         if expect_like is None:
             try:
-                # structure-only init: eval_shape never runs the program,
-                # so this is a cheap, key-independent config reference
-                expect_like = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+                # structure-only init+step: eval_shape never runs the
+                # program, and snapshots are written at step boundaries —
+                # one traced step materializes any lazily-sized monitor
+                # buffers (LineageMonitor's width-discovered rings), so
+                # the reference has the SNAPSHOT's structure. For
+                # structure-stable states this equals the init structure.
+                expect_like = jax.eval_shape(
+                    lambda k: self.step(self.init(k)), jax.random.PRNGKey(0)
+                )
             except Exception:
-                expect_like = None  # exotic init: guard disarms, resume works
+                try:
+                    expect_like = jax.eval_shape(
+                        self.init, jax.random.PRNGKey(0)
+                    )
+                except Exception:
+                    expect_like = None  # exotic init: guard disarms
         state = checkpointer.latest(
             expect_like=expect_like,
             allow_config_mismatch=allow_config_mismatch,

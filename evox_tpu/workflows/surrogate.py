@@ -66,6 +66,7 @@ from ..operators.surrogate import (
     SurrogateArchive,
     spearman_correlation,
 )
+from ..utils.ring import ring_write
 from .common import finish_step, ingest_fitness, quarantine_nonfinite
 from .std import StdWorkflow, StdWorkflowState
 
@@ -499,21 +500,9 @@ class SurrogateWorkflow(StdWorkflow):
             model, refits, last_refit = sur.model, sur.refits, sur.last_refit_gen
         n = eval_mask.shape[0]
         ev = plan.full_eval & plan.warm  # a TRIGGERED fallback, not warmup
-        slot = sur.fb_count % self.fallback_log
-        fb_gens = jnp.where(
-            ev,
-            jax.lax.dynamic_update_index_in_dim(
-                sur.fb_gens, gen_after, slot, 0
-            ),
-            sur.fb_gens,
-        )
-        fb_reasons = jnp.where(
-            ev,
-            jax.lax.dynamic_update_index_in_dim(
-                sur.fb_reasons, plan.reason, slot, 0
-            ),
-            sur.fb_reasons,
-        )
+        # shared ring discipline (utils/ring.py): conditional writes
+        fb_gens = ring_write(sur.fb_gens, gen_after, sur.fb_count, cond=ev)
+        fb_reasons = ring_write(sur.fb_reasons, plan.reason, sur.fb_count, cond=ev)
         i32 = lambda b: b.astype(jnp.int32)  # noqa: E731
         return SurrogateState(
             archive=archive,

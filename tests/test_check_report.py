@@ -694,8 +694,8 @@ def test_validator_v11_schema_version_rules():
     """v11 reports must carry a schema_version int that agrees with the
     schema tag suffix; v10-and-earlier reports stay exempt."""
     report = _fresh_report(False)
-    assert report["schema"] == "evox_tpu.run_report/v12"
-    assert report["schema_version"] == 12
+    assert report["schema"] == "evox_tpu.run_report/v13"
+    assert report["schema_version"] == 13
     bad = json.loads(json.dumps(report))
     del bad["schema_version"]
     errors = "\n".join(check_report.validate_run_report(bad))
@@ -854,7 +854,7 @@ def test_validate_file_sniffs_metrics_stream(tmp_path):
 def test_schema_flag_lists_and_detects(tmp_path, capsys):
     assert check_report.main(["--schema"]) == 0
     out = capsys.readouterr().out
-    assert "evox_tpu.run_report/v12" in out
+    assert "evox_tpu.run_report/v13" in out
     assert "evox_tpu.metrics_stream/v1" in out
     from evox_tpu import FlightRecorder
 
@@ -1016,3 +1016,154 @@ def test_validator_v12_control_plane_bench_rules():
     del bad["control_plane"]["report"]["slo"]
     errors = "\n".join(check_report.validate_bench(bad))
     assert "SLO ledger is the leg's referee" in errors
+
+
+# ---------------------------------------------------------------- v13
+
+
+def _search_section():
+    """Minimal coherent v13 ``search`` section (ISSUE 19): 3 gens × 2
+    slots, gen 0 credited to init, one restart-free epoch."""
+    return {
+        "enabled": True,
+        "generations": 3,
+        "capacity": 4,
+        "width": 2,
+        "num_objectives": 1,
+        "epoch": 0,
+        "restarts": 0,
+        "ledger": {
+            "init": {"attempts": 2, "successes": 2, "improvement": 1.0},
+            "de_rand_1": {"attempts": 4, "successes": 1, "improvement": 0.5},
+        },
+        "ancestry": [
+            {"generation": 3, "slot": 0, "parent": 1, "op": "de_rand_1", "epoch": 0},
+            {"generation": 2, "slot": 1, "parent": 0, "op": "de_rand_1", "epoch": 0},
+            {"generation": 1, "slot": 0, "parent": 0, "op": "init", "epoch": 0},
+        ],
+        "age": {"max": 2, "mean": 1.0},
+        "trajectory": {
+            "generation": [1, 2, 3],
+            "best_slot": [0, 1, 0],
+            "best_fitness": [5.0, 3.0, 1.0],
+            "delta": [0.0, 2.0, 2.0],
+            "epoch": [0, 0, 0],
+        },
+    }
+
+
+def test_validator_v13_search_section_rules():
+    base = _fresh_report(False)
+    base["search"] = _search_section()
+    assert check_report.validate_run_report(base) == []
+
+    # degraded + disabled forms are valid and minimal
+    ok = json.loads(json.dumps(base))
+    ok["search"] = {"error": "boom"}
+    assert check_report.validate_run_report(ok) == []
+    ok["search"] = {"enabled": False}
+    assert check_report.validate_run_report(ok) == []
+
+    # ledger accounting: attempts must sum to generations*width, a
+    # success needs an attempt, operators come from the shared vocabulary
+    bad = json.loads(json.dumps(base))
+    bad["search"]["ledger"]["de_rand_1"]["attempts"] = 5
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "attempts sum" in errors
+    bad = json.loads(json.dumps(base))
+    bad["search"]["ledger"]["de_rand_1"]["successes"] = 99
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "cannot succeed without being attempted" in errors
+    bad = json.loads(json.dumps(base))
+    bad["search"]["ledger"]["warp_drive"] = bad["search"]["ledger"].pop(
+        "de_rand_1"
+    )
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "not a known operator tag" in errors
+
+    # ancestry: in-range indices, consecutive descent, one epoch
+    bad = json.loads(json.dumps(base))
+    bad["search"]["ancestry"][0]["slot"] = 7
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "not in [0, width=2)" in errors
+    bad = json.loads(json.dumps(base))
+    bad["search"]["ancestry"][1]["generation"] = 1
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "descend consecutively" in errors
+    bad = json.loads(json.dumps(base))
+    bad["search"]["ancestry"][2]["epoch"] = 1
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "across a restart/exploit boundary is fiction" in errors
+
+    # trajectory: delta non-negative, epochs only advance, track lengths
+    bad = json.loads(json.dumps(base))
+    bad["search"]["trajectory"]["delta"][1] = -0.5
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "delta has negative entries" in errors
+    bad = json.loads(json.dumps(base))
+    bad["search"]["trajectory"]["epoch"] = [1, 0, 0]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "epoch decreases" in errors
+    bad = json.loads(json.dumps(base))
+    bad["search"]["trajectory"]["best_slot"] = [0, 1]
+    errors = "\n".join(check_report.validate_run_report(bad))
+    assert "length mismatch" in errors
+
+    # MO runs must carry the churn/front-size rings, coherently
+    mo = json.loads(json.dumps(base))
+    mo["search"]["num_objectives"] = 2
+    errors = "\n".join(check_report.validate_run_report(mo))
+    assert "front_size" in errors and "churn" in errors
+    mo["search"]["trajectory"]["front_size"] = [1, 2, 2]
+    mo["search"]["trajectory"]["churn"] = [0.0, 0.1, 0.05]
+    assert check_report.validate_run_report(mo) == []
+    mo["search"]["trajectory"]["front_size"] = [1, 2, 9]
+    errors = "\n".join(check_report.validate_run_report(mo))
+    assert "front_size out of" in errors
+
+
+def test_validator_bench_trajectory_rules(tmp_path):
+    """The cross-PR BENCH_TRAJECTORY.json (ISSUE 19 satellite): the repo
+    artifact validates, the file dispatch recognises the schema, and the
+    rules catch unknown rounds / bad flags / schema drift."""
+    repo_file = REPO / "BENCH_TRAJECTORY.json"
+    assert repo_file.exists(), (
+        "BENCH_TRAJECTORY.json missing — regenerate with "
+        "python tools/bench_trajectory.py"
+    )
+    traj = json.loads(repo_file.read_text())
+    assert check_report.validate_bench_trajectory(traj) == []
+    assert check_report.validate_file(str(repo_file)) == []
+    assert (
+        check_report.detect_schema(str(repo_file))
+        == "evox_tpu.bench_trajectory/v1"
+    )
+    assert any(
+        "bench_trajectory" in s for s in check_report.SUPPORTED_SCHEMAS
+    )
+
+    bad = json.loads(json.dumps(traj))
+    bad["schema"] = "evox_tpu.bench_trajectory/v99"
+    assert any(
+        "schema" in e for e in check_report.validate_bench_trajectory(bad)
+    )
+    bad = json.loads(json.dumps(traj))
+    key = next(iter(bad["legs"]))
+    bad["legs"][key]["history"][0]["round"] = 99999
+    assert any(
+        "not among rounds" in e
+        for e in check_report.validate_bench_trajectory(bad)
+    )
+    bad = json.loads(json.dumps(traj))
+    bad["legs"][key]["flags"] = {"ratio_regression": "yes"}
+    assert any(
+        "flags" in e for e in check_report.validate_bench_trajectory(bad)
+    )
+    # a tail-recovered round must explain itself
+    bad = json.loads(json.dumps(traj))
+    for rnd in bad["rounds"]:
+        rnd["notes"] = []
+    assert any(
+        "provenance note" in e
+        for e in check_report.validate_bench_trajectory(bad)
+    )

@@ -385,3 +385,73 @@ def test_pod_supervisor_failure_carries_flight_recorder_tail():
     assert tape and tape[0]["name"] == "pod.before"
     assert any(r.get("name", "").startswith("pod.") for r in tape[1:])
     json.dumps(pm, allow_nan=False)
+
+
+# ------------------------------------------------- search view (ISSUE 19)
+
+_SEARCH_SECTION = {
+    "enabled": True,
+    "generations": 3,
+    "capacity": 4,
+    "width": 2,
+    "num_objectives": 1,
+    "epoch": 0,
+    "restarts": 0,
+    "ledger": {
+        "init": {"attempts": 2, "successes": 2, "improvement": 1.0},
+        "de_rand_1": {"attempts": 4, "successes": 1, "improvement": 0.5},
+    },
+    "trajectory": {
+        "generation": [1, 2, 3],
+        "best_slot": [0, 1, 0],
+        "best_fitness": [5.0, 3.0, 1.0],
+        "delta": [0.0, 2.0, 2.0],
+        "epoch": [0, 0, 0],
+    },
+}
+
+
+def test_record_search_publishes_gauges_and_evoxtail_renders(tmp_path):
+    """record_search maps a run_report search section onto the search.*
+    gauge namespace; evoxtail --search renders exactly this card (byte-
+    pinned: the view is a scrape-side contract, like the OpenMetrics
+    parity law above)."""
+    fr = FlightRecorder(directory=str(tmp_path))
+    fr.record_search(_SEARCH_SECTION)
+    fr.sample(generation=3)
+    sg = {
+        k: v
+        for k, v in fr.registry.snapshot()["gauges"].items()
+        if k.startswith("search.")
+    }
+    assert sg["search.generations"] == 3
+    assert sg["search.ledger.de_rand_1.attempts"] == 4
+    assert sg["search.best_fitness"] == 1.0  # newest trajectory row
+    assert sg["search.delta"] == 2.0
+
+    records = read_stream(str(tmp_path / "metrics.jsonl"))
+    assert evoxtail.render_search(records) == [
+        "search dynamics (newest sample)",
+        "  generations  3   width 2   epoch 0 (restarts 0)",
+        "  best fitness 1",
+        "  last delta   2",
+        "",
+        "operator attribution ledger",
+        "  operator   attempts  successes  improvement",
+        "  de_rand_1         4          1          0.5",
+        "  init              2          2            1",
+    ]
+
+
+def test_record_search_disabled_is_noop():
+    fr = FlightRecorder()
+    fr.record_search({"enabled": False})
+    fr.record_search({"error": "lineage blew up"})
+    assert not any(
+        k.startswith("search.")
+        for k in fr.registry.snapshot()["gauges"]
+    )
+    assert evoxtail.render_search([{"kind": "sample", "gauges": {}}]) == [
+        "no search.* gauges — attach a LineageMonitor and "
+        "publish via FlightRecorder.record_search"
+    ]

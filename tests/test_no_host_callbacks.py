@@ -110,6 +110,18 @@ def test_telemetry_modules_exist_and_are_callback_free():
         assert rel not in users, f"{rel} must not use host callbacks"
 
 
+def test_lineage_and_attribution_are_callback_free():
+    """The search-dynamics tentpole (ISSUE 19) records lineage/ledger
+    rings entirely on device — its forensics (best_ancestry, ledger,
+    search_report) read fetched arrays AFTER the run. A callback in
+    either module would break the one place convergence forensics
+    matter most: long fused runs on the axon-tunneled TPU."""
+    users = _scan()
+    for rel in ("monitors/lineage.py", "core/attribution.py"):
+        assert (PKG / rel).exists(), f"{rel} missing"
+        assert rel not in users, f"{rel} must not use host callbacks"
+
+
 def test_control_plane_is_callback_free():
     """The multi-pod gateway (ISSUE 18) is host-side scheduling by
     construction — ledger appends, journal parses, checkpoint-manifest

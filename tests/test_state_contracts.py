@@ -478,9 +478,13 @@ def test_surrogate_state_contracts():
 def test_monitor_state_contracts():
     """Monitor states: frozen pytree dataclasses, all fields P() (their
     buffers are capacity-leading, not population-leading)."""
-    from evox_tpu.monitors import EvalMonitor, TelemetryMonitor
+    from evox_tpu.monitors import EvalMonitor, LineageMonitor, TelemetryMonitor
 
-    for mon in (TelemetryMonitor(capacity=4), EvalMonitor()):
+    for mon in (
+        TelemetryMonitor(capacity=4),
+        EvalMonitor(),
+        LineageMonitor(history_capacity=4),
+    ):
         mstate = mon.init(jax.random.PRNGKey(0))
         if mstate is None:  # pragma: no cover
             continue

@@ -321,8 +321,8 @@ def test_instrument_and_run_report(tmp_path):
 
     report = run_report(wf, state, recorder=rec, extra={"tag": "unit"})
     # v3: v2's roofline provenance plus the optional tenancy section
-    assert report["schema"] == "evox_tpu.run_report/v12"
-    assert report["schema_version"] == 12
+    assert report["schema"] == "evox_tpu.run_report/v13"
+    assert report["schema_version"] == 13
     assert report["generation"] == 17
     tel = report["telemetry"][0]
     assert tel["monitor"] == "TelemetryMonitor"

@@ -1,0 +1,435 @@
+#!/usr/bin/env python3
+"""Aggregate the repo's ``BENCH_r*.json`` round captures into one
+machine-validated ``BENCH_TRAJECTORY.json`` (ISSUE 19 satellite).
+
+The per-round files were written by different drivers across the repo's
+history and come in three shapes:
+
+- a raw bench summary (``{metric, value, unit, vs_baseline, sub_metrics,
+  ...}``) — the newer rounds;
+- a driver envelope (``{n, cmd, rc, tail, parsed}``) whose ``parsed`` is
+  that summary — the early rounds;
+- an envelope whose ``tail`` was truncated mid-JSON (``parsed: null``) —
+  legs are best-effort recovered from complete ``{"metric": ...}``
+  objects inside the fragment, and the round is flagged
+  ``tail_recovered`` so nobody mistakes partial coverage for a full
+  capture.
+
+The output is a per-leg ratio history with the provenance/honesty notes
+the bench methodology demands (self-baselined legs — "NOT the reference,
+excluded from the geomean" — stay marked; official ratios are medians of
+interleaved per-round ratios, so ``vs_baseline`` is cross-checked
+against ``median(ratio_rounds)`` where both exist) plus
+monotonicity/drift flags: a leg whose newest ratio fell more than 10%
+below its best earlier ratio is a ``ratio_regression``, one whose value
+fell more than 20% below its best is a ``value_regression`` — the "did
+PR N make the chip slower" question answered by a file instead of a
+spelunking session.
+
+Stdlib-only (the tools/ discipline: runs anywhere, validated by
+tools/check_report.py which understands the
+``evox_tpu.bench_trajectory/v1`` schema). ``bench.py`` calls
+:func:`rebuild` after printing its summary so the trajectory stays
+current; run it by hand with ``python tools/bench_trajectory.py
+[repo_dir]``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+TRAJECTORY_SCHEMA = "evox_tpu.bench_trajectory/v1"
+TRAJECTORY_FILENAME = "BENCH_TRAJECTORY.json"
+ROUND_GLOB = "BENCH_r*.json"
+
+#: ratio drop (vs the leg's best earlier round) that flags a regression
+RATIO_REGRESSION_FRAC = 0.10
+#: value drop (vs the leg's best earlier round) that flags a regression
+VALUE_REGRESSION_FRAC = 0.20
+#: |vs_baseline - median(ratio_rounds)| / vs_baseline tolerance — the
+#: bench contract says the official ratio IS the median of the
+#: interleaved per-round ratios, so a bigger gap means a mislabeled leg
+MEDIAN_COHERENCE_FRAC = 0.05
+
+#: legs whose 'baseline' is our own code, not the reference — the metric
+#: text says so explicitly; their ratios are tracked but must never be
+#: read as reference speedups
+_SELF_BASELINE_RE = re.compile(r"NOT the reference|excluded from the geomean")
+
+
+def leg_key(metric: str) -> str:
+    """Stable short key for one leg: the metric text before its first
+    parenthesised qualifier (the qualifiers carry per-round commentary
+    and would split one leg into many)."""
+    return metric.split(" (", 1)[0].strip()
+
+
+def _num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _legs_of_summary(summary: dict) -> List[dict]:
+    subs = summary.get("sub_metrics")
+    if isinstance(subs, list) and subs:
+        return [s for s in subs if isinstance(s, dict) and "metric" in s]
+    if "metric" in summary:
+        # single-leg rounds (r01) carry the leg at top level
+        return [summary]
+    return []
+
+
+# complete {"metric": ...} objects inside a truncated fragment: at each
+# '{"metric"' start, raw_decode parses exactly one complete JSON value
+# (or raises on a truncated one)
+_METRIC_START = re.compile(r'\{"metric"')
+_DECODER = json.JSONDecoder()
+
+
+def _recover_legs_from_fragment(text: str) -> List[dict]:
+    legs = []
+    pos = 0
+    for m in _METRIC_START.finditer(text):
+        if m.start() < pos:  # nested inside an already-recovered object
+            continue
+        try:
+            obj, end = _DECODER.raw_decode(text, m.start())
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            legs.append(obj)
+            pos = end
+    return legs
+
+
+def load_round(path: str) -> dict:
+    """One ``BENCH_r*.json`` -> a normalized round record with explicit
+    provenance (``source``) and honesty notes."""
+    name = os.path.basename(path)
+    m = re.search(r"r(\d+)", name)
+    rnd = int(m.group(1)) if m else -1
+    out: dict = {"round": rnd, "file": name, "notes": []}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        out["source"] = "unreadable"
+        out["legs"] = []
+        out["notes"].append(f"unreadable: {type(e).__name__}: {e}")
+        return out
+    if not isinstance(data, dict):
+        out["source"] = "unreadable"
+        out["legs"] = []
+        out["notes"].append("not a JSON object")
+        return out
+    if "sub_metrics" in data or ("metric" in data and "tail" not in data):
+        summary = data
+        out["source"] = "summary"
+    elif isinstance(data.get("parsed"), dict):
+        summary = data["parsed"]
+        out["source"] = "parsed"
+        if data.get("rc") not in (0, None):
+            out["notes"].append(f"driver rc={data.get('rc')}")
+    else:
+        # envelope whose summary line was truncated out of the tail:
+        # recover what leg objects survived, and say so
+        tail = data.get("tail")
+        legs = (
+            _recover_legs_from_fragment(tail) if isinstance(tail, str) else []
+        )
+        out["source"] = "tail_recovered"
+        out["legs"] = [_norm_leg(leg) for leg in legs]
+        out["notes"].append(
+            f"summary truncated in driver tail; recovered "
+            f"{len(legs)} complete leg objects — coverage is PARTIAL, "
+            "absent legs are unknown for this round, not missing"
+        )
+        out["geomean_vs_baseline"] = None
+        return out
+    out["geomean_vs_baseline"] = (
+        summary.get("vs_baseline") if _num(summary.get("vs_baseline")) else None
+    )
+    out["legs"] = [_norm_leg(leg) for leg in _legs_of_summary(summary)]
+    if not out["legs"]:
+        out["notes"].append("summary carried no parseable legs")
+    return out
+
+
+def _norm_leg(leg: dict) -> dict:
+    entry: dict = {
+        "key": leg_key(str(leg.get("metric", ""))),
+        "metric": leg.get("metric"),
+        "value": leg.get("value") if _num(leg.get("value")) else None,
+        "unit": leg.get("unit"),
+        "vs_baseline": (
+            leg.get("vs_baseline") if _num(leg.get("vs_baseline")) else None
+        ),
+        "self_baselined": bool(
+            _SELF_BASELINE_RE.search(str(leg.get("metric", "")))
+        ),
+    }
+    rr = leg.get("ratio_rounds")
+    if isinstance(rr, list) and rr and all(_num(r) for r in rr):
+        entry["ratio_rounds"] = [float(r) for r in rr]
+        entry["ratio_spread"] = round(max(rr) - min(rr), 6)
+    return entry
+
+
+def build_trajectory(
+    round_paths: List[str], extra_rounds: Optional[List[dict]] = None
+) -> dict:
+    """Aggregate round records into the trajectory document."""
+    rounds = sorted(
+        (load_round(p) for p in round_paths), key=lambda r: r["round"]
+    )
+    for extra in extra_rounds or ():
+        rounds.append(extra)
+    rounds.sort(key=lambda r: r["round"])
+
+    legs: Dict[str, dict] = {}
+    for rnd in rounds:
+        for leg in rnd["legs"]:
+            key = leg["key"]
+            slot = legs.setdefault(
+                key,
+                {
+                    "unit": leg.get("unit"),
+                    "self_baselined": leg["self_baselined"],
+                    "history": [],
+                    "flags": {},
+                    "notes": [],
+                },
+            )
+            point = {
+                "round": rnd["round"],
+                "value": leg["value"],
+                "vs_baseline": leg["vs_baseline"],
+                "source": rnd["source"],
+            }
+            for k in ("ratio_rounds", "ratio_spread"):
+                if k in leg:
+                    point[k] = leg[k]
+            slot["history"].append(point)
+            # once self-baselined, always flagged: a leg that changed its
+            # baseline mid-history is exactly what the honesty notes exist
+            # to surface
+            if leg["self_baselined"] != slot["self_baselined"]:
+                slot["self_baselined"] = True
+                note = (
+                    "baseline definition changed across rounds — ratios "
+                    "are not comparable over the whole history"
+                )
+                if note not in slot["notes"]:
+                    slot["notes"].append(note)
+
+    notes: List[str] = [
+        "official per-leg ratios are medians of interleaved per-round "
+        "ratios (bench.py _differenced protocol); ratio_spread records "
+        "the per-leg round-to-round drift",
+        "self_baselined legs compare against OUR OWN prior/alternate "
+        "path, not the reference — excluded from geomeans by the bench "
+        "contract",
+    ]
+    for key, slot in legs.items():
+        hist = [p for p in slot["history"] if p["vs_baseline"] is not None]
+        flags = slot["flags"]
+        if len(hist) >= 2:
+            best_prev = max(p["vs_baseline"] for p in hist[:-1])
+            newest = hist[-1]["vs_baseline"]
+            flags["ratio_regression"] = bool(
+                newest < best_prev * (1.0 - RATIO_REGRESSION_FRAC)
+            )
+            flags["ratio_monotone_nondecreasing"] = all(
+                b["vs_baseline"] >= a["vs_baseline"] - 1e-9
+                for a, b in zip(hist, hist[1:])
+            )
+        vals = [p for p in slot["history"] if p["value"] is not None]
+        if len(vals) >= 2:
+            best_prev = max(p["value"] for p in vals[:-1])
+            flags["value_regression"] = bool(
+                vals[-1]["value"] < best_prev * (1.0 - VALUE_REGRESSION_FRAC)
+            )
+        # median coherence: official ratio == median of its rounds
+        for p in slot["history"]:
+            rr = p.get("ratio_rounds")
+            if rr and p["vs_baseline"]:
+                med = statistics.median(rr)
+                if (
+                    abs(med - p["vs_baseline"])
+                    > abs(p["vs_baseline"]) * MEDIAN_COHERENCE_FRAC
+                ):
+                    slot["notes"].append(
+                        f"round {p['round']}: vs_baseline "
+                        f"{p['vs_baseline']} is not the median of its "
+                        f"ratio_rounds ({med:g}) — mislabeled or "
+                        "re-keyed leg"
+                    )
+
+    return {
+        "schema": TRAJECTORY_SCHEMA,
+        "rounds": [
+            {k: v for k, v in rnd.items() if k != "legs"} for rnd in rounds
+        ],
+        "legs": legs,
+        "notes": notes,
+    }
+
+
+def validate_trajectory(traj: Any, where: str = "trajectory") -> List[str]:
+    """Self-check (mirrored by tools/check_report.py so the repo's one
+    validator entry point understands the file)."""
+    errors: List[str] = []
+    if not isinstance(traj, dict):
+        return [f"{where}: not a JSON object"]
+    if traj.get("schema") != TRAJECTORY_SCHEMA:
+        errors.append(
+            f"{where}: schema {traj.get('schema')!r} != {TRAJECTORY_SCHEMA!r}"
+        )
+    rounds = traj.get("rounds")
+    if not isinstance(rounds, list) or not rounds:
+        errors.append(f"{where}: rounds missing or empty")
+        rounds = []
+    last = None
+    for i, rnd in enumerate(rounds):
+        loc = f"{where}: rounds[{i}]"
+        if not isinstance(rnd, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        r = rnd.get("round")
+        if not isinstance(r, int):
+            errors.append(f"{loc}.round missing")
+        elif last is not None and r < last:
+            errors.append(f"{loc}.round {r} not ascending")
+        else:
+            last = r
+        if rnd.get("source") not in (
+            "summary",
+            "parsed",
+            "tail_recovered",
+            "unreadable",
+        ):
+            errors.append(f"{loc}.source {rnd.get('source')!r} unknown")
+        if rnd.get("source") == "tail_recovered" and not rnd.get("notes"):
+            errors.append(
+                f"{loc}: tail-recovered round carries no provenance note"
+            )
+    legs = traj.get("legs")
+    if not isinstance(legs, dict):
+        errors.append(f"{where}: legs missing")
+        legs = {}
+    known_rounds = {
+        r.get("round") for r in rounds if isinstance(r, dict)
+    }
+    for key, slot in legs.items():
+        loc = f"{where}: legs[{key!r}]"
+        hist = slot.get("history")
+        if not isinstance(hist, list) or not hist:
+            errors.append(f"{loc}.history missing or empty")
+            continue
+        prev = None
+        for j, p in enumerate(hist):
+            ploc = f"{loc}.history[{j}]"
+            r = p.get("round")
+            if r not in known_rounds:
+                errors.append(f"{ploc}.round {r!r} not among rounds")
+            if prev is not None and isinstance(r, int) and r < prev:
+                errors.append(f"{ploc}.round not ascending")
+            prev = r if isinstance(r, int) else prev
+            if p.get("value") is not None and (
+                not _num(p["value"]) or p["value"] < 0
+            ):
+                errors.append(f"{ploc}.value negative/non-numeric")
+            if p.get("vs_baseline") is not None and (
+                not _num(p["vs_baseline"]) or p["vs_baseline"] <= 0
+            ):
+                errors.append(f"{ploc}.vs_baseline non-positive")
+            rr = p.get("ratio_rounds")
+            if rr is not None and (
+                not isinstance(rr, list)
+                or not rr
+                or not all(_num(v) and v > 0 for v in rr)
+            ):
+                errors.append(f"{ploc}.ratio_rounds malformed")
+        flags = slot.get("flags")
+        if not isinstance(flags, dict) or not all(
+            isinstance(v, bool) for v in flags.values()
+        ):
+            errors.append(f"{loc}.flags missing or non-boolean")
+        if not isinstance(slot.get("self_baselined"), bool):
+            errors.append(f"{loc}.self_baselined missing")
+    if not isinstance(traj.get("notes"), list):
+        errors.append(f"{where}: notes missing")
+    return errors
+
+
+def rebuild(
+    repo_dir: str = ".",
+    extra_rounds: Optional[List[dict]] = None,
+    out_path: Optional[str] = None,
+) -> Tuple[dict, str]:
+    """Aggregate ``repo_dir``'s round files (plus any in-memory
+    ``extra_rounds`` — bench.py passes the run it just finished) and
+    write ``BENCH_TRAJECTORY.json``. Returns ``(trajectory, path)``.
+    Raises on validation failure rather than writing a broken file."""
+    paths = sorted(glob.glob(os.path.join(repo_dir, ROUND_GLOB)))
+    traj = build_trajectory(paths, extra_rounds)
+    errors = validate_trajectory(traj)
+    if errors:
+        raise ValueError(
+            "refusing to write an invalid trajectory:\n  "
+            + "\n  ".join(errors)
+        )
+    path = out_path or os.path.join(repo_dir, TRAJECTORY_FILENAME)
+    with open(path, "w") as f:
+        json.dump(traj, f, indent=1, sort_keys=False, allow_nan=False)
+        f.write("\n")
+    return traj, path
+
+
+def summary_as_round(summary: dict, round_no: int) -> dict:
+    """Wrap a live in-memory bench summary (the dict bench.py prints) as
+    one provisional round record for :func:`rebuild`'s
+    ``extra_rounds`` — provenance says it has not been archived as a
+    ``BENCH_r*.json`` yet."""
+    return {
+        "round": round_no,
+        "file": None,
+        "source": "summary",
+        "geomean_vs_baseline": (
+            summary.get("vs_baseline")
+            if _num(summary.get("vs_baseline"))
+            else None
+        ),
+        "legs": [_norm_leg(leg) for leg in _legs_of_summary(summary)],
+        "notes": ["live run appended by bench.py — not yet archived"],
+    }
+
+
+def main(argv: List[str]) -> int:
+    repo = argv[0] if argv else os.path.dirname(os.path.dirname(__file__))
+    try:
+        traj, path = rebuild(repo)
+    except ValueError as e:
+        print(f"bench_trajectory: {e}", file=sys.stderr)
+        return 1
+    n_legs = len(traj["legs"])
+    flagged = sorted(
+        key
+        for key, slot in traj["legs"].items()
+        if any(slot["flags"].get(k) for k in ("ratio_regression", "value_regression"))
+    )
+    print(
+        f"bench_trajectory: {path}: {len(traj['rounds'])} rounds, "
+        f"{n_legs} legs"
+        + (f", REGRESSIONS: {', '.join(flagged)}" if flagged else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -217,6 +217,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     surrogate = report.get("surrogate")
     if surrogate is not None:
         errors += _validate_surrogate(surrogate, where)
+    search = report.get("search")
+    if search is not None:
+        errors += _validate_search(search, where)
     control_plane = report.get("control_plane")
     if control_plane is not None:
         errors += _validate_control_plane(control_plane, where)
@@ -866,6 +869,229 @@ def _validate_surrogate(sur: Any, where: str) -> List[str]:
             errors.append(
                 f"{loc}.reason {r!r} is not a known fallback bitmask "
                 f"(known bits: {_SURROGATE_REASON_MASK:#x})"
+            )
+    return errors
+
+
+# v13 (ISSUE 19, monitors/lineage.py + core/attribution.py): the
+# operator-attribution tag vocabulary — ledger keys and ancestry op tags
+# must come from here (append-only in the source; renaming would corrupt
+# forensics across checkpoint resumes)
+SEARCH_OP_NAMES = {
+    "none",
+    "init",
+    "sample",
+    "velocity",
+    "de_rand_1",
+    "de_rand_2",
+    "de_rand_to_best_2",
+    "de_cur_to_rand_1",
+    "de_cur_to_pbest_1",
+    "de_best",
+    "crossover",
+    "mutation",
+}
+
+
+def _validate_search(search: Any, where: str) -> List[str]:
+    """The ``search`` section (schema v13, ISSUE 19,
+    monitors/lineage.py): the attribution ledger must be coherent —
+    per-operator ``successes <= attempts``, improvement mass
+    non-negative, and total attempts exactly ``generations * width``
+    (every generation attributes every slot exactly once); the
+    best-ancestry chain must carry in-range slot/parent indices, strictly
+    descending consecutive generations, and a single epoch (the monitor
+    never walks an edge across a restart); the trajectory window's delta
+    is non-negative (best-so-far is monotone), its epoch non-decreasing,
+    and the MO churn/front-size rings non-negative and front sizes within
+    the batch width."""
+    errors: List[str] = []
+    if not isinstance(search, dict):
+        return [f"{where}: search is not an object"]
+    if set(search) == {"error"}:
+        # degraded form, same contract as roofline.error
+        if not isinstance(search["error"], str):
+            errors.append(f"{where}: search.error is not a string")
+        return errors
+    enabled = search.get("enabled")
+    if not isinstance(enabled, bool):
+        errors.append(f"{where}: search.enabled missing or not a bool")
+    if not enabled:
+        return errors  # disabled sections are minimal by design
+    for key in ("generations", "capacity", "width", "epoch", "restarts"):
+        v = search.get(key)
+        if not isinstance(v, int) or v < 0:
+            errors.append(
+                f"{where}: search.{key} missing or not a non-negative int"
+            )
+    gens = search.get("generations")
+    cap = search.get("capacity")
+    width = search.get("width")
+    if isinstance(cap, int) and cap < 1:
+        errors.append(f"{where}: search.capacity {cap} < 1")
+    epoch, restarts = search.get("epoch"), search.get("restarts")
+    if (
+        isinstance(epoch, int)
+        and isinstance(restarts, int)
+        and epoch < restarts
+    ):
+        errors.append(
+            f"{where}: search.epoch {epoch} < restarts {restarts} — the "
+            "epoch counter includes every restart"
+        )
+    # ---- ledger: the credit table sums must add up
+    ledger = search.get("ledger")
+    if not isinstance(ledger, dict):
+        errors.append(f"{where}: search.ledger missing")
+        ledger = {}
+    total_attempts = 0
+    for op, row in ledger.items():
+        loc = f"{where}: search.ledger.{op}"
+        if op not in SEARCH_OP_NAMES:
+            errors.append(f"{loc} is not a known operator tag")
+        if not isinstance(row, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        a, s, imp = row.get("attempts"), row.get("successes"), row.get(
+            "improvement"
+        )
+        for key, v in (("attempts", a), ("successes", s)):
+            if not isinstance(v, int) or v < 0:
+                errors.append(f"{loc}.{key} missing or not a non-negative int")
+        if isinstance(a, int) and isinstance(s, int) and s > a:
+            errors.append(
+                f"{loc}: successes {s} > attempts {a} — a candidate "
+                "cannot succeed without being attempted"
+            )
+        if not _num(imp) or imp < 0:
+            errors.append(
+                f"{loc}.improvement missing or negative — improvement "
+                "mass is clipped at the source"
+            )
+        if isinstance(a, int):
+            total_attempts += a
+    if (
+        isinstance(gens, int)
+        and isinstance(width, int)
+        and total_attempts != gens * width
+    ):
+        errors.append(
+            f"{where}: search.ledger attempts sum to {total_attempts} but "
+            f"generations*width = {gens * width} — every generation "
+            "attributes every slot exactly once"
+        )
+    # ---- ancestry: the traceback chain must be walkable
+    ancestry = search.get("ancestry")
+    if not isinstance(ancestry, list):
+        errors.append(f"{where}: search.ancestry missing")
+        ancestry = []
+    if (
+        isinstance(gens, int)
+        and isinstance(cap, int)
+        and len(ancestry) > min(gens, cap)
+    ):
+        errors.append(
+            f"{where}: search.ancestry has {len(ancestry)} links but only "
+            f"min(generations={gens}, capacity={cap}) are recorded"
+        )
+    prev_gen = None
+    chain_epochs = set()
+    for i, link in enumerate(ancestry):
+        loc = f"{where}: search.ancestry[{i}]"
+        if not isinstance(link, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        g = link.get("generation")
+        if not isinstance(g, int) or g < 1 or (
+            isinstance(gens, int) and g > gens
+        ):
+            errors.append(f"{loc}.generation {g!r} out of range")
+        elif prev_gen is not None and g != prev_gen - 1:
+            errors.append(
+                f"{loc}.generation {g} does not descend consecutively "
+                f"from {prev_gen} — the chain is newest-first, one link "
+                "per generation"
+            )
+        prev_gen = g if isinstance(g, int) else prev_gen
+        for key in ("slot", "parent"):
+            v = link.get(key)
+            if not isinstance(v, int) or v < 0 or (
+                isinstance(width, int) and width > 0 and v >= width
+            ):
+                errors.append(
+                    f"{loc}.{key} {v!r} not in [0, width={width})"
+                )
+        if link.get("op") not in SEARCH_OP_NAMES:
+            errors.append(f"{loc}.op {link.get('op')!r} unknown")
+        if isinstance(link.get("epoch"), int):
+            chain_epochs.add(link["epoch"])
+        else:
+            errors.append(f"{loc}.epoch missing or not an int")
+    if len(chain_epochs) > 1:
+        errors.append(
+            f"{where}: search.ancestry spans epochs {sorted(chain_epochs)} "
+            "— descent across a restart/exploit boundary is fiction"
+        )
+    # ---- trajectory window (+ MO churn coherence)
+    traj = search.get("trajectory")
+    if not isinstance(traj, dict):
+        errors.append(f"{where}: search.trajectory missing")
+        traj = {}
+    tg = traj.get("generation")
+    if not isinstance(tg, list):
+        errors.append(f"{where}: search.trajectory.generation missing")
+        tg = []
+    if isinstance(cap, int) and len(tg) > cap:
+        errors.append(
+            f"{where}: search.trajectory holds {len(tg)} rows but "
+            f"capacity is {cap}"
+        )
+    if tg != sorted(tg):
+        errors.append(f"{where}: search.trajectory.generation not ascending")
+    track_keys = ["best_slot", "best_fitness", "delta", "epoch"]
+    is_mo = isinstance(search.get("num_objectives"), int) and search[
+        "num_objectives"
+    ] > 1
+    if is_mo:
+        track_keys += ["front_size", "churn"]
+    for key in track_keys:
+        col = traj.get(key)
+        if not isinstance(col, list) or len(col) != len(tg):
+            errors.append(
+                f"{where}: search.trajectory.{key} missing or length "
+                f"mismatch with .generation"
+            )
+            continue
+        if key == "delta" and any(not _num(v) or v < 0 for v in col):
+            errors.append(
+                f"{where}: search.trajectory.delta has negative entries — "
+                "best-so-far deltas are non-negative by construction"
+            )
+        if key == "epoch" and col != sorted(col):
+            errors.append(
+                f"{where}: search.trajectory.epoch decreases — restart "
+                "epochs only ever advance"
+            )
+        if key == "best_slot" and isinstance(width, int) and width > 0 and any(
+            not isinstance(v, int) or v < 0 or v >= width for v in col
+        ):
+            errors.append(
+                f"{where}: search.trajectory.best_slot out of [0, {width})"
+            )
+        if key == "churn" and any(not _num(v) or v < 0 for v in col):
+            errors.append(
+                f"{where}: search.trajectory.churn has negative or "
+                "non-numeric entries"
+            )
+        if key == "front_size" and any(
+            not isinstance(v, int)
+            or v < 0
+            or (isinstance(width, int) and width > 0 and v > width)
+            for v in col
+        ):
+            errors.append(
+                f"{where}: search.trajectory.front_size out of "
+                f"[0, width={width}]"
             )
     return errors
 
@@ -2057,6 +2283,23 @@ def validate_bench_envelope(env: dict, where: str = "bench-envelope") -> List[st
     return validate_bench(summary, where=where)
 
 
+def validate_bench_trajectory(
+    traj: Any, where: str = "bench-trajectory"
+) -> List[str]:
+    """``evox_tpu.bench_trajectory/v1`` — the cross-PR ratio-history
+    file built by tools/bench_trajectory.py. The rules live THERE (one
+    source of truth; the builder refuses to write an invalid file), this
+    entry point just routes the shared validator surface to them."""
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if here not in sys.path:
+        sys.path.insert(0, here)
+    import bench_trajectory
+
+    return bench_trajectory.validate_trajectory(traj, where)
+
+
 def validate_chrome_trace(trace: Any, where: str = "trace") -> List[str]:
     errors: List[str] = []
     if not isinstance(trace, dict) or not isinstance(
@@ -2185,6 +2428,10 @@ def validate_file(path: str) -> List[str]:
             return [f"{path}: invalid JSON: {e}"]
     if isinstance(obj, dict) and "traceEvents" in obj:
         errors = validate_chrome_trace(obj)
+    elif isinstance(obj, dict) and str(obj.get("schema", "")).startswith(
+        "evox_tpu.bench_trajectory/"
+    ):
+        errors = validate_bench_trajectory(obj)
     elif isinstance(obj, dict) and "sub_metrics" in obj:
         errors = validate_bench(obj)
     elif isinstance(obj, dict) and "tail" in obj and "cmd" in obj:
@@ -2200,8 +2447,9 @@ def validate_file(path: str) -> List[str]:
 #: ``--schema`` prints so drivers/tests can pin the supported range
 #: without parsing the module
 SUPPORTED_SCHEMAS = (
-    "evox_tpu.run_report/v12 (validates v1-v12)",
+    "evox_tpu.run_report/v13 (validates v1-v13)",
     "evox_tpu.metrics_stream/v1",
+    "evox_tpu.bench_trajectory/v1",
     "bench summary (sub_metrics)",
     "bench envelope (cmd+tail)",
     "chrome trace (traceEvents)",

@@ -168,6 +168,67 @@ def render_slo(slo: Dict[str, Any]) -> List[str]:
     ]
 
 
+def render_search(records: List[dict]) -> List[str]:
+    """The search/lineage view (--search): the ``search.*`` gauge
+    namespace a FlightRecorder.record_search publish left in the newest
+    sample (monitors/lineage.py LineageMonitor), rendered as the
+    convergence-forensics card — run shape, the newest window's best /
+    delta (and front size / churn for MO runs), and the per-operator
+    attribution ledger table."""
+    sample = newest(records, "sample")
+    gauges = (sample or {}).get("gauges") or {}
+    search = {
+        k[len("search."):]: v
+        for k, v in gauges.items()
+        if k.startswith("search.")
+    }
+    if not search:
+        return ["no search.* gauges — attach a LineageMonitor and "
+                "publish via FlightRecorder.record_search"]
+    lines = ["search dynamics (newest sample)"]
+    lines.append(
+        f"  generations  {_fmt_num(search.get('generations', 0))}"
+        f"   width {_fmt_num(search.get('width', 0))}"
+        f"   epoch {_fmt_num(search.get('epoch', 0))}"
+        f" (restarts {_fmt_num(search.get('restarts', 0))})"
+    )
+    for key, label in (
+        ("best_fitness", "best fitness"),
+        ("delta", "last delta"),
+        ("front_size", "front size"),
+        ("churn", "front churn"),
+    ):
+        if key in search:
+            lines.append(f"  {label:<12} {_fmt_num(search[key])}")
+    ledger: Dict[str, Dict[str, Any]] = {}
+    for k, v in search.items():
+        if k.startswith("ledger."):
+            try:
+                _, op, field = k.split(".", 2)
+            except ValueError:
+                continue
+            ledger.setdefault(op, {})[field] = v
+    if ledger:
+        lines.append("")
+        lines.append("operator attribution ledger")
+        width = max(len(op) for op in ledger)
+        lines.append(
+            f"  {'operator':<{max(width, 8)}}  attempts  successes  improvement"
+        )
+        # heaviest-attempted first: the table reads as "where the run
+        # spent its candidates"
+        for op, row in sorted(
+            ledger.items(), key=lambda kv: -float(kv[1].get("attempts", 0))
+        ):
+            lines.append(
+                f"  {op:<{max(width, 8)}}"
+                f"  {_fmt_num(row.get('attempts', 0)):>8}"
+                f"  {_fmt_num(row.get('successes', 0)):>9}"
+                f"  {_fmt_num(row.get('improvement', 0)):>11}"
+            )
+    return lines
+
+
 def render_summary(records: List[dict], path: str) -> List[str]:
     lines = [f"stream: {path}"]
     meta = newest(records, "meta")
@@ -355,6 +416,12 @@ def main(argv: List[str]) -> int:
         help="OpenMetrics exposition of the newest sample",
     )
     ap.add_argument(
+        "--search",
+        action="store_true",
+        help="search-dynamics view: the search.* lineage/attribution "
+        "gauges of the newest sample",
+    )
+    ap.add_argument(
         "--interval",
         type=float,
         default=0.5,
@@ -389,6 +456,9 @@ def main(argv: List[str]) -> int:
             print(f"evoxtail: {path} has no sample records", file=sys.stderr)
             return 1
         sys.stdout.write(to_openmetrics(sample))
+        return 0
+    if args.search:
+        print("\n".join(render_search(records)))
         return 0
     if args.replay:
         for rec in records:
