@@ -1584,6 +1584,58 @@ def bench_cso_metrics_bare():
     return _cso_metrics_measurer(None), CSO_POP
 
 
+# ---------------------------------------------------------------- workload 12b
+# The attestation overhead A/B (PR 20): the SAME fused CSO workload with
+# a StateAttestor monitor digesting the full state INSIDE the fori_loop
+# at cadence ATT_EVERY — one lax.cond around ~6 uint32 reduction words
+# per leaf every 10th generation — against OUR OWN identical fused drive
+# with no attestor. Both sides OURS: excluded from the geomean.
+# vs_baseline = bare/attested wall ratio; the acceptance law is
+# ratio >= 0.98 (<= 2% wall at cadence 10), PERF_NOTES §28 records the
+# measured number and the cost model. Both sides are ONE fused dispatch
+# per trip count, so the differenced slope isolates the in-loop digest
+# math, not dispatch latency.
+
+ATT_EVERY = 10  # attestation cadence (generations) inside the fused loop
+ATT_PAIR = (100, 600)  # fused-generation trip counts
+
+
+def _cso_attest_measurer(attested):
+    from evox_tpu import StdWorkflow
+    from evox_tpu.algorithms.so.pso import CSO
+    from evox_tpu.core.attest import StateAttestor
+    from evox_tpu.problems.numerical import Ackley
+
+    algo = CSO(
+        lb=-32.0 * jnp.ones(CSO_DIM),
+        ub=32.0 * jnp.ones(CSO_DIM),
+        pop_size=CSO_POP,
+    )
+    monitors = (
+        (StateAttestor(every=ATT_EVERY, capacity=64),) if attested else ()
+    )
+    wf = StdWorkflow(algo, Ackley(), monitors=monitors)
+    state = wf.init(jax.random.PRNGKey(42))
+
+    def timed(n):
+        t0 = time.perf_counter()
+        s = wf.run(state, n)
+        _fetch(s)
+        return time.perf_counter() - t0
+
+    for n in ATT_PAIR:
+        timed(n)  # compile + warm both trip counts
+    return _differenced(timed, *ATT_PAIR)
+
+
+def bench_cso_attested():
+    return _cso_attest_measurer(True), CSO_POP
+
+
+def bench_cso_attest_bare():
+    return _cso_attest_measurer(False), CSO_POP
+
+
 # ---------------------------------------------------------------- workload 13
 # The multi-pod control-plane churn leg (PR 18): sustained tenant-gens/sec
 # through a journal-backed gateway over CPL_PODS pods with ONE pod
@@ -1984,6 +2036,20 @@ WORKLOADS = [
         bench_cso_metrics_bare,
         ROOFLINES["cso"],
     ),
+    (
+        "attest_overhead",
+        f"CSO/Ackley attestation overhead evals/sec (pop={CSO_POP}, "
+        f"dim={CSO_DIM}, one fused dispatch per trip count with a "
+        f"StateAttestor digesting the full state in-loop every "
+        f"{ATT_EVERY} generations; 'baseline' is the IDENTICAL fused "
+        "drive with no attestor, NOT the reference — excluded from the "
+        "geomean. vs_baseline = bare/attested wall ratio; the PR-20 "
+        "overhead law wants >= 0.98, i.e. <= 2% wall at cadence 10)",
+        "evals/sec",
+        bench_cso_attested,
+        bench_cso_attest_bare,
+        ROOFLINES["cso"],
+    ),
 ]
 
 # legs whose "baseline" is not the reference: reported, never geomeaned.
@@ -1998,6 +2064,7 @@ NON_REFERENCE_BUILDERS = {
     bench_large_pop_sharded,  # A/B against OUR replicated sampling law
     bench_surrogate_screened,  # A/B against OUR full-evaluation workflow
     bench_cso_metrics_instrumented,  # A/B against OUR bare chunked drive
+    bench_cso_attested,  # A/B against OUR un-attested fused drive
 }
 NON_REFERENCE_LEGS = {
     metric for _, metric, _, ours_fn, _, _ in WORKLOADS

@@ -63,6 +63,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from .attest import IntegrityError
 
 # Layering note: this module lives in core/ (it is workflow-shape-agnostic
 # infrastructure: any object with pipeline_ask/pipeline_tell or run(state,
@@ -284,7 +287,20 @@ class GenerationExecutor:
             # surrogate refits dispatched between tells (ISSUE 15,
             # workflows/surrogate.py refit_due/dispatch_refit hooks)
             "bg_refit": 0,
+            # compute-integrity rung (ISSUE 20, core/attest.py): extra
+            # dispatches spent re-running chunks for verification, chunks
+            # whose digests agreed, digest mismatches detected, and
+            # mismatches healed by the 2-of-3 vote. Coherence law:
+            # verify_dispatches == verified_chunks + 2 * mismatches
+            # (one re-dispatch per rung, one more per mismatch).
+            "verify_dispatches": 0,
+            "verified_chunks": 0,
+            "integrity_mismatches": 0,
+            "integrity_healed": 0,
         }
+        # rung configuration/outcome state the counters can't carry:
+        # newest run's cadence (None = rung off) and no-majority aborts
+        self.integrity: Dict[str, Any] = {"verify_every": None, "aborts": 0}
         self.queue_stats: Dict[str, int] = {
             "io_inflight_limit": self.io_inflight,
             "io_inflight_max": 0,
@@ -399,6 +415,8 @@ class GenerationExecutor:
         supervisor: Any = None,
         pod_supervisor: Any = None,
         entry: str = "run",
+        attest: Any = None,
+        verify_every: Optional[int] = None,
     ) -> Any:
         """Drive ``wf.run(state, n)``-shaped fused dispatches in cadence
         chunks: the loop previously hand-rolled by ``checkpointed_run``,
@@ -425,6 +443,20 @@ class GenerationExecutor:
         pod_supervisor.PodFailureError` (fatal to the in-process ladder
         by design; re-formation happens in the respawn driver). ``None``
         (default) leaves this loop bit-identical to the pre-pod tree.
+
+        ``verify_every=K`` (with ``attest``, a :class:`~evox_tpu.core.
+        attest.StateAttestor`; a default one is built if omitted) is the
+        compute-integrity rung (ISSUE 20): every K-th completed chunk is
+        re-dispatched from its immutable pre-chunk entry state (the PR-6
+        snapshot-before-donate guarantee makes the entry state free) and
+        the two results' layout-invariant digests compared. On mismatch a
+        third dispatch votes 2-of-3: the majority state proceeds, the
+        dissent is journaled against the pod (quarantine via the PR-14
+        re-formation path, not a whole-run abort); no majority raises
+        :class:`~evox_tpu.core.attest.IntegrityError` (classified
+        ``integrity`` — never retried). ``attest=None`` with
+        ``verify_every=None`` (default) is the established no-op
+        discipline: zero extra dispatches, bit-identical to pre-PR.
         """
         from ..workflows.checkpoint import chunk_to_boundary, enter_run
 
@@ -443,7 +475,16 @@ class GenerationExecutor:
         if ckpt is None and supervisor is not None:
             ckpt = getattr(supervisor, "checkpointer", None)
         self.counters["runs"] += 1
+        if verify_every is not None:
+            if verify_every < 1:
+                raise ValueError(f"verify_every must be >= 1, got {verify_every}")
+            if attest is None:
+                from .attest import StateAttestor
+
+                attest = StateAttestor()
+            self.integrity["verify_every"] = int(verify_every)
         total = n_steps + int(state.generation)
+        chunk_i = 0  # completed chunks THIS run — the verify-rung cadence
         budget = {"used": 0}  # restores bounded per RUN, not per chunk
         restore = self._restore_thunk(supervisor, ckpt, wf, state)
         lane = _IoLane("checkpoint", self.io_inflight)
@@ -480,6 +521,27 @@ class GenerationExecutor:
                     )
                 else:
                     state = dispatch()
+                chunk_i += 1
+                if (
+                    attest is not None
+                    and verify_every is not None
+                    and chunk_i % verify_every == 0
+                    # only a chunk that truly ran to completion can be
+                    # re-dispatched for comparison — a restore-rung result
+                    # is an older snapshot, not this chunk's output
+                    and int(state.generation)
+                    == int(attempted.generation) + step
+                ):
+                    state = self._verify_chunk(
+                        wf,
+                        attempted,
+                        state,
+                        step,
+                        attest,
+                        entry=entry,
+                        supervisor=supervisor,
+                        pod=pod,
+                    )
                 self.counters["chunks"] += 1
                 gen = int(state.generation)
                 progressed = gen > int(attempted.generation)
@@ -527,6 +589,111 @@ class GenerationExecutor:
             lane.close()
             self._account_lane(lane)
             self.overlap["wall_s"] += self._clock() - t_run0
+
+    # ------------------------------------------------------- integrity rung
+    def _verify_chunk(
+        self,
+        wf: Any,
+        attempted: Any,
+        state: Any,
+        step: int,
+        attest: Any,
+        *,
+        entry: str,
+        supervisor: Any,
+        pod: Any,
+    ) -> Any:
+        """ISSUE 20 voted re-dispatch: re-run the chunk from its immutable
+        entry state and compare layout-invariant digests. Agreement
+        verifies the chunk; a mismatch escalates to a third dispatch and
+        the 2-of-3 majority wins, with the dissent noted against the pod
+        (journaled ``pod_failure`` classification ``integrity_dissent`` —
+        the PodManager re-formation driver quarantines the pod, the run
+        itself proceeds on the majority state). No majority is an
+        :class:`IntegrityError`: three mutually disagreeing results leave
+        nothing trustworthy to continue from."""
+
+        def _dispatch_again() -> Any:
+            fn = lambda: wf.run(attempted, step)  # noqa: E731
+            if pod is not None:
+                raw = fn
+                fn = lambda: pod.supervised(raw, entry=f"{entry}:verify")  # noqa: E731
+            dispatch = lambda: self._timed_dispatch(  # noqa: E731
+                f"{entry}:verify", fn
+            )
+            if supervisor is not None:
+                # transient dispatch faults during verification retry as
+                # usual; no restore rung — the entry state IS the snapshot
+                return supervisor.call(dispatch, entry=f"{entry}:verify")
+            return dispatch()
+
+        def _digest(s: Any) -> tuple:
+            return tuple(
+                int(v) for v in np.asarray(jax.device_get(attest.digest(s)))
+            )
+
+        gen = int(state.generation)
+        self.counters["verify_dispatches"] += 1
+        redo = _dispatch_again()
+        d0, d1 = _digest(state), _digest(redo)
+        if d0 == d1:
+            self.counters["verified_chunks"] += 1
+            return state
+        self.counters["integrity_mismatches"] += 1
+        if supervisor is not None:
+            supervisor._event(
+                "integrity_mismatch", entry=entry, generation=gen
+            )
+        if self.metrics is not None:
+            self.metrics.count("executor.integrity_mismatches")
+            self.metrics.event(
+                "integrity.mismatch", entry=entry, generation=gen
+            )
+        self.counters["verify_dispatches"] += 1
+        third = _dispatch_again()
+        d2 = _digest(third)
+        if d2 == d1:
+            winner, dissent = redo, "first"
+        elif d2 == d0:
+            winner, dissent = state, "redo"
+        else:
+            self.integrity["aborts"] += 1
+            raise IntegrityError(
+                f"no 2-of-3 majority at generation {gen}: three dispatches "
+                f"of the same chunk produced three distinct digests — "
+                f"nothing trustworthy to continue from",
+                generation=gen,
+                where=f"{entry}:verify",
+            )
+        self.counters["integrity_healed"] += 1
+        if supervisor is not None:
+            supervisor._event(
+                "integrity_heal", entry=entry, generation=gen, dissent=dissent
+            )
+        if self.metrics is not None:
+            self.metrics.count("executor.integrity_healed")
+            self.metrics.event(
+                "integrity.heal", entry=entry, generation=gen, dissent=dissent
+            )
+        if pod is not None and hasattr(pod, "note_integrity_dissent"):
+            pod.note_integrity_dissent(
+                generation=gen, entry=entry, dissent=dissent
+            )
+        return winner
+
+    def integrity_counters(self) -> Dict[str, Any]:
+        """The executor's contribution to run_report v14 ``integrity``
+        (``None`` when the verify rung never armed — the no-op form)."""
+        if self.integrity["verify_every"] is None:
+            return None
+        return {
+            "verify_every": self.integrity["verify_every"],
+            "redispatches": self.counters["verify_dispatches"],
+            "verified_chunks": self.counters["verified_chunks"],
+            "mismatches": self.counters["integrity_mismatches"],
+            "healed": self.counters["integrity_healed"],
+            "aborted": self.integrity["aborts"],
+        }
 
     # ---------------------------------------------------------- host-eval runs
     def run_host(

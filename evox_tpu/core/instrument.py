@@ -696,10 +696,18 @@ def run_report(
     # ancestry traceback, restart-epoch counter, per-generation
     # best/delta trajectory, and the MO front-size/churn rings) —
     # validated when present, incl. the successes≤attempts ledger rule,
-    # ancestry-indices-in-range, and churn non-negativity.
+    # ancestry-indices-in-range, and churn non-negativity. v14 adds the
+    # optional `integrity` section (ISSUE 20, core/attest.py
+    # StateAttestor + core/executor.py voted re-dispatch): the on-device
+    # attestation ring (generation-stamped state digests at a cadence),
+    # the verify rung's dispatch/mismatch/heal counters, any
+    # bisect_divergence() forensics report, and a one-word verdict
+    # (clean/detected/healed/aborted) — validated when present, incl.
+    # the cadence-monotone ring, verdict-set, bisection-in-window, and
+    # redispatch-counter coherence rules.
     report: dict = {
-        "schema": "evox_tpu.run_report/v13",
-        "schema_version": 13,
+        "schema": "evox_tpu.run_report/v14",
+        "schema_version": 14,
     }
     if state is not None and hasattr(state, "generation"):
         report["generation"] = int(state.generation)
@@ -759,6 +767,23 @@ def run_report(
                         report["search"] = mon.search_report(mstates[i])
                     except Exception as e:  # must never sink the report
                         report["search"] = {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
+                    break
+        # compute-integrity attestation (schema v14, core/attest.py):
+        # the first attached monitor exposing `integrity_report` (a
+        # StateAttestor) contributes the generation-stamped digest ring;
+        # the executor's verify counters and any forensics report join
+        # it below, after the executor pickup
+        if mstates is not None:
+            for i, mon in enumerate(getattr(workflow, "monitors", ())):
+                if hasattr(mon, "integrity_report"):
+                    try:
+                        report["integrity"] = mon.integrity_report(
+                            mstates[i]
+                        )
+                    except Exception as e:  # must never sink the report
+                        report["integrity"] = {
                             "error": f"{type(e).__name__}: {e}"
                         }
                     break
@@ -878,6 +903,46 @@ def run_report(
         control_plane = getattr(workflow, "_control_plane", None)
     if control_plane is not None and hasattr(control_plane, "report"):
         report["control_plane"] = control_plane.report()
+    # compute-integrity verify/forensics (schema v14, ISSUE 20): the
+    # executor's voted re-dispatch counters (None until the verify rung
+    # was armed) and any bisect_divergence() report — advertised as
+    # `workflow._integrity_forensics` — join the attestor ring picked up
+    # above; the verdict folds the layer's whole story into one word
+    verify = (
+        executor.integrity_counters()
+        if executor is not None and hasattr(executor, "integrity_counters")
+        else None
+    )
+    forensics = (
+        getattr(workflow, "_integrity_forensics", None)
+        if workflow is not None
+        else None
+    )
+    integ = report.get("integrity")
+    if (
+        isinstance(integ, dict) and "error" in integ
+    ):  # ring pickup failed — leave the error section as-is
+        pass
+    elif integ is not None or verify is not None or forensics is not None:
+        if integ is None:
+            integ = {"enabled": True, "attestations": 0, "ring": []}
+        if verify is not None:
+            integ["verify"] = verify
+        if forensics is not None:
+            integ["bisection"] = dict(forensics)
+        v = integ.get("verify") or {}
+        if v.get("aborted"):
+            integ["verdict"] = "aborted"
+        elif v.get("healed"):
+            integ["verdict"] = "healed"
+        elif v.get("mismatches") or (
+            forensics is not None
+            and forensics.get("first_divergent_generation") is not None
+        ):
+            integ["verdict"] = "detected"
+        else:
+            integ["verdict"] = "clean"
+        report["integrity"] = integ
     if extra:
         report["extra"] = dict(extra)
     return sanitize_json(report)

@@ -309,14 +309,57 @@ class TelemetryMonitor(Monitor):
             tracks[f"telemetry/{name}"] = [(last, int(v))]
         return tracks
 
-    def fingerprint(self, mstate: TelemetryState) -> str:
-        """SHA-256 over the EXACT bytes of every telemetry field (rings
-        included) — a cheap host-side bit-identity witness. Two runs
-        whose fingerprints match produced byte-identical trajectories
-        and counters; the supervisor chaos law (tests/test_supervisor.py)
-        asserts a faulted-and-healed run fingerprints identically to the
-        clean run, and a post-mortem can cite the fingerprint as
-        evidence of how far a run got before aborting."""
+    # The bitwise-stable counter surface: integer accumulators whose bits
+    # are identical across device-mesh layouts (each is a count of exact
+    # events — no float reduction whose summation order a resharding could
+    # permute). The float rings and best_key are deliberately OUT: a mean
+    # over a differently-laid-out population batch may legally differ in
+    # the last ulp, and a fingerprint that flickers across layouts is
+    # worse than none.
+    STABLE_SURFACE = (
+        "generations",
+        "evals",
+        "nan_candidates",
+        "inf_candidates",
+        "nan_fitness",
+        "inf_fitness",
+        "best_generation",
+        "stagnation",
+        "restarts",
+        "last_trigger",
+        "sur_true_evals",
+        "sur_fallback_gens",
+    )
+
+    def fingerprint(self, mstate: TelemetryState, stable: bool = False) -> str:
+        """Host-side bit-identity witness over the telemetry state.
+
+        Default (``stable=False``): SHA-256 over the EXACT bytes of every
+        telemetry field (rings included). Two runs whose fingerprints
+        match produced byte-identical trajectories and counters; the
+        supervisor chaos law (tests/test_supervisor.py) asserts a
+        faulted-and-healed run fingerprints identically to the clean run,
+        and a post-mortem can cite the fingerprint as evidence of how far
+        a run got before aborting. This form is layout-DEPENDENT: the
+        float rings hold reduction results (mean fitness, diversity)
+        whose bits can shift across device-mesh layouts.
+
+        ``stable=True``: the attestor reduction (:func:`evox_tpu.core.
+        attest.host_state_digest`) over only the integer counter surface
+        (``STABLE_SURFACE``) — bitwise-identical across 1/4/8-device
+        layouts because every field is an exact event count. Use this
+        form for cross-layout equality laws; use the default when both
+        runs share one layout and you want the rings covered too. The two
+        forms are different widths (48 vs 64 hex chars) so they can never
+        be confused for one another.
+        """
+        if stable:
+            from ..core.attest import digest_hex, host_state_digest
+
+            surface = {
+                name: getattr(mstate, name) for name in self.STABLE_SURFACE
+            }
+            return digest_hex(host_state_digest(surface))
         import hashlib
 
         h = hashlib.sha256()

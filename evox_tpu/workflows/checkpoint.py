@@ -76,7 +76,17 @@ from typing import Any, List, Optional
 
 import jax
 
+from ..core.attest import IntegrityError, digest_hex, host_state_digest
+
 _SCHEMA = "evox_tpu.workflow_checkpoint/v1"
+
+
+def attest_digest_hex(state: Any) -> str:
+    """Hex attestation of a (host) state — the NumPy digest mirror, so
+    manifest writing costs one host pass, no device dispatch. Bitwise
+    equal to the on-device ``state_digest`` of the same bits (the
+    core/attest.py host-mirror law)."""
+    return digest_hex(host_state_digest(state))
 
 # Crash-injection hook for the process-chaos harness (tests/_proc_chaos.py):
 # when set, it is called with a named point inside the durable-write path
@@ -300,6 +310,15 @@ class WorkflowCheckpointer:
             "bytes": len(payload),
             "sha256": hashlib.sha256(payload).hexdigest(),
             "file": path.name,
+            # compute-integrity attestation (ISSUE 20, core/attest.py):
+            # the layout-invariant digest of the STATE the payload
+            # unpickles to, not of the payload bytes — sha256 above
+            # guards the file, this guards the bits the run will resume
+            # from (_load_validated recomputes and refuses a mismatch)
+            "attest": {
+                "digest": attest_digest_hex(host_state),
+                "generation": gen,
+            },
             # structural identity of the run (see state_config_fingerprint)
             "config_sha": state_config_fingerprint(host_state),
             # provenance only: the snapshot itself is topology-free host
@@ -444,7 +463,21 @@ class WorkflowCheckpointer:
             digest = hashlib.sha256(payload).hexdigest()
             if digest != manifest["sha256"]:
                 raise ValueError("sha256 mismatch")
-            return manifest, pickle.loads(payload)
+            state = pickle.loads(payload)
+            att = manifest.get("attest")  # absent in pre-v20 manifests
+            if isinstance(att, dict) and "digest" in att:
+                got = attest_digest_hex(state)
+                if got != att["digest"]:
+                    # file bytes intact but the STATE is not the one
+                    # attested at save time — same corrupt-skip law as a
+                    # torn payload: warn, fall back one snapshot
+                    raise IntegrityError(
+                        f"state digest {got} != manifest attestation "
+                        f"{att['digest']}",
+                        generation=manifest.get("generation"),
+                        where=path.name,
+                    )
+            return manifest, state
         except Exception as e:
             warnings.warn(
                 f"skipping corrupt checkpoint {path.name}: {e}", stacklevel=2

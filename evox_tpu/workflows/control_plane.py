@@ -88,6 +88,30 @@ def _crash_point(point: str) -> None:
         hook(point)
 
 
+def _checkpoint_attest(checkpoint_dir: Any) -> Optional[dict]:
+    """The parked checkpoint's newest manifest attestation (ISSUE 20):
+    ``{"digest", "generation"}`` read straight out of the manifest JSON —
+    pure file I/O, no unpickling — so the steal record pins the bits the
+    moved tenant is supposed to resume from. ``None`` for pre-v20
+    manifests or anything unreadable (the steal itself never fails on a
+    missing attestation — verification is the RESUMER's job)."""
+    import json
+
+    try:
+        manifests = sorted(
+            Path(checkpoint_dir).glob("ckpt_????????.pkl.manifest.json")
+        )
+        if not manifests:
+            return None
+        with open(manifests[-1]) as f:
+            att = json.load(f).get("attest")
+        if not isinstance(att, dict) or att.get("digest") is None:
+            return None
+        return {"digest": att["digest"], "generation": att.get("generation")}
+    except Exception:
+        return None
+
+
 def _parse_bucket_key(name: str) -> Optional[BucketShape]:
     m = _BUCKET_KEY.match(name)
     if m is None:
@@ -682,6 +706,13 @@ class ControlPlane:
         checkpoint: Optional[str],
         source_seq: Optional[int],
     ) -> None:
+        # when the tenant moves WITH a parked checkpoint, the steal
+        # record also pins that checkpoint's manifest attestation — an
+        # auditor (or the resuming pod) can later prove the resumed bits
+        # are the parked bits without trusting the file system
+        attest = (
+            _checkpoint_attest(checkpoint) if checkpoint is not None else None
+        )
         self.ledger.append(
             "steal",
             tag=tag,
@@ -689,6 +720,7 @@ class ControlPlane:
             to_pod=to_pod,
             bucket=bucket,
             checkpoint=checkpoint,
+            attest=attest,
             source_seq=source_seq,
         )
         self.counters["stolen"] += 1

@@ -328,6 +328,49 @@ class FlightRecorder:
             if isinstance(col, list) and col:
                 self.set(f"search.{key}", float(col[-1]))
 
+    def record_integrity(self, integrity: Dict[str, Any]) -> None:
+        """Publish a compute-integrity report (core/attest.py, the
+        run_report schema-v14 ``integrity`` section) into the
+        ``integrity.*`` gauge namespace — same host-side cadence and
+        absolute-value discipline as :meth:`record_search`. Gauges:
+        ``integrity.attestations`` (ring count) and the newest ring
+        entry's ``integrity.last_generation``; the verify rung's
+        ``integrity.redispatches`` / ``integrity.verified_chunks``
+        / ``integrity.mismatches`` / ``integrity.healed`` /
+        ``integrity.aborted``; bisection forensics publish
+        ``integrity.first_divergent_generation`` when one was named.
+        The verdict rides as an ``integrity.verdict`` event whenever it
+        is not ``clean`` (events are the anomaly lane; a clean run adds
+        zero event records)."""
+        if not isinstance(integrity, dict) or not integrity.get("enabled"):
+            return
+        if isinstance(integrity.get("attestations"), (int, float)):
+            self.set(
+                "integrity.attestations", float(integrity["attestations"])
+            )
+        ring = integrity.get("ring") or []
+        if ring and isinstance(ring[-1].get("generation"), (int, float)):
+            self.set(
+                "integrity.last_generation", float(ring[-1]["generation"])
+            )
+        verify = integrity.get("verify") or {}
+        for key in (
+            "redispatches",
+            "verified_chunks",
+            "mismatches",
+            "healed",
+            "aborted",
+        ):
+            if isinstance(verify.get(key), (int, float)):
+                self.set(f"integrity.{key}", float(verify[key]))
+        bisection = integrity.get("bisection") or {}
+        fdg = bisection.get("first_divergent_generation")
+        if isinstance(fdg, (int, float)):
+            self.set("integrity.first_divergent_generation", float(fdg))
+        verdict = integrity.get("verdict")
+        if verdict and verdict != "clean":
+            self.event("integrity.verdict", verdict=verdict)
+
     def report(self) -> dict:
         """The ``metrics`` section of ``run_report()`` (schema v11,
         validated by tools/check_report.py)."""

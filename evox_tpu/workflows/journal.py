@@ -90,6 +90,13 @@ EVENT_KINDS = (
     "pod_drain",
     "pod_reform",
     "pod_resume",
+    # compute integrity (ISSUE 20, core/attest.py): `attest` pins a
+    # state digest at a generation (the bisect_divergence replay input);
+    # `integrity` records a detected digest violation and the healing
+    # action taken (barrier fallback, voted re-dispatch, quarantine) —
+    # corruption is never silently retried into acceptance
+    "attest",
+    "integrity",
 )
 
 

@@ -19,7 +19,8 @@ host-side (no callbacks, axon-safe):
   Python; it is daemonized and abandoned — the tunnel either answers
   late into the void or never.)
 - **Classifier**: :func:`classify_error` folds the zoo of backend
-  failures into ``transient`` / ``oom`` / ``deadline`` / ``fatal``.
+  failures into ``transient`` / ``oom`` / ``deadline`` / ``fatal`` /
+  ``integrity`` (wrong bits — never retried, see core/attest.py).
   Classification is by exception type AND message patterns, so the fake
   faults of tests/_chaos.py::FlakyDispatch classify exactly like the
   real jaxlib ``XlaRuntimeError`` strings they mimic.
@@ -59,6 +60,7 @@ import re
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core.attest import IntegrityError
 from ..core.distributed import BarrierTimeoutError
 from ..core.pod_supervisor import (
     CollectiveDeadlineError,
@@ -76,6 +78,7 @@ __all__ = [
     "OOM",
     "DEADLINE",
     "FATAL",
+    "INTEGRITY",
 ]
 
 
@@ -100,6 +103,11 @@ TRANSIENT = "transient"
 OOM = "oom"
 DEADLINE = "deadline"
 FATAL = "fatal"
+# ISSUE 20: a digest violation is its OWN class, distinct from transient —
+# retrying corrupt bits "heals" nothing and risks accepting them; the only
+# valid responses are an explicit heal (voted re-dispatch, barrier
+# fallback) or an abort, never the retry rung
+INTEGRITY = "integrity"
 
 # Message fingerprints of retryable backend failures. gRPC/absl status
 # names cover jaxlib's XlaRuntimeError surface (one exception type for
@@ -145,6 +153,12 @@ def classify_error(exc: BaseException) -> str:
     fatal — a supervisor never re-litigates another's verdict), and
     patterns are matched against the MESSAGE only, never the type name
     (``RunAbortedError``'s own name must not read as 'aborted')."""
+    if isinstance(exc, IntegrityError):
+        # wrong BITS, not a failed dispatch (ISSUE 20): the chunk
+        # "succeeded" with corrupt state, so no amount of retrying the
+        # same path can be trusted to produce different evidence —
+        # healing is the caller's explicit job (vote / barrier fallback)
+        return INTEGRITY
     if isinstance(exc, (DispatchDeadlineError, CollectiveDeadlineError, BarrierTimeoutError)):
         # the pod-level deadlines (ISSUE 14) fold into the same class as
         # the dispatch watchdog's: a bounded wait expired
@@ -248,6 +262,8 @@ class RunSupervisor:
         seed: int = 0,
         clock: Callable[[], float] = time.perf_counter,
         metrics: Any = None,
+        attest: Any = None,
+        verify_every: Optional[int] = None,
     ):
         if max_retries < 0 or max_restores < 0:
             raise ValueError("max_retries and max_restores must be >= 0")
@@ -261,6 +277,13 @@ class RunSupervisor:
         self.backoff_factor = backoff_factor
         self.jitter = jitter
         self.min_eval_chunk = min_eval_chunk
+        # compute-integrity rung (ISSUE 20): when both are set, fused runs
+        # re-dispatch every verify_every-th chunk from its immutable entry
+        # state and compare digests (2-of-3 vote on mismatch). None/None —
+        # the default — is the established no-op discipline: zero extra
+        # dispatches, bit-identical to pre-PR.
+        self.attest = attest
+        self.verify_every = verify_every
         self._rng = random.Random(seed)
         # serving-plane flight recorder (PR 16): when attached, every
         # ladder event mirrors into the live metrics plane and aborts
@@ -397,6 +420,12 @@ class RunSupervisor:
                     )
                 if kind == FATAL:
                     self._abort(entry, e, rung="fatal")
+                if kind == INTEGRITY:
+                    # never retried into acceptance: the voted re-dispatch
+                    # rung (executor) and the barrier fallback (tenancy
+                    # recover) heal BEFORE raising; an IntegrityError that
+                    # reaches the ladder means healing already failed
+                    self._abort(entry, e, rung="integrity")
                 if kind == OOM and degrade is not None and degrade():
                     self._event("degrade", entry=entry, error=str(e)[:300])
                     continue
@@ -481,6 +510,8 @@ class RunSupervisor:
             resume_from=resume_from,
             supervisor=self,
             pod_supervisor=pod_supervisor,
+            attest=self.attest,
+            verify_every=self.verify_every,
         )
 
     # --------------------------------------------------------- pipelined runs

@@ -71,6 +71,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as _SpecP
 
 from ..core.algorithm import Algorithm
+from ..core.attest import IntegrityError
 from ..core.distributed import (
     POP_AXIS as _POP,
     TENANT_AXIS as _TENANT,
@@ -1022,6 +1023,7 @@ class RunQueue:
         journal: Any = None,
         health_policy: Any = None,
         metrics: Any = None,
+        attest: Any = None,
     ):
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -1100,6 +1102,18 @@ class RunQueue:
                 and getattr(health_policy, "metrics", None) is None
             ):
                 health_policy.metrics = metrics
+        # compute-integrity (PR 20): an attestor pins a digest of the
+        # fleet state onto every chunk_complete barrier record, so
+        # recover() can verify a restored snapshot's BITS against the
+        # journal — a corrupt-but-sha256-consistent snapshot is refused
+        # and recovery falls back one barrier. `attest=None` is an exact
+        # no-op; `attest=True` builds the default StateAttestor.
+        if attest is True:
+            from ..core.attest import StateAttestor
+
+            attest = StateAttestor()
+        self.attest = attest
+        self.integrity_events: List[dict] = []
         self.health_events: List[dict] = []
         self._slot_restarts: List[int] = [0] * workflow.n_tenants
         self._config_sha: Optional[str] = None
@@ -1592,6 +1606,15 @@ class RunQueue:
             counter="bg_checkpoint",
         )
         gen = int(state.generation)
+        # the attestation is computed BEFORE the background pickle runs:
+        # the journal pins the digest of the bits the barrier describes,
+        # not whatever the snapshot file ends up holding (one jitted
+        # dispatch; only the digest words are fetched)
+        extra = {}
+        if self.attest is not None:
+            att_rec = self.attest.attestation(state)
+            att_rec["generation"] = gen
+            extra["attest"] = att_rec
         self.journal.append(
             "chunk_complete",
             generation=gen,
@@ -1620,6 +1643,7 @@ class RunQueue:
             results_len=len(self.results),
             health_len=len(self.health_events),
             slot_restarts=list(self._slot_restarts),
+            **extra,
         )
 
     # ------------------------------------------------------- health policy
@@ -2104,6 +2128,7 @@ class RunQueue:
         health_policy: Any = None,
         allow_config_mismatch: bool = False,
         metrics: Any = None,
+        attest: Any = None,
     ) -> "RunQueue":
         """Rebuild a journaled sweep after the driver died — at ANY
         point, including mid-background-fsync.
@@ -2169,6 +2194,7 @@ class RunQueue:
             journal=journal,
             health_policy=health_policy,
             metrics=metrics,
+            attest=attest,
         )
         q._spec_seq = max(specs, default=-1) + 1
         q.counters["submitted"] = len(specs)
@@ -2275,11 +2301,48 @@ class RunQueue:
         barriers = [r for r in recs if r["kind"] == "chunk_complete"]
         meta: Optional[dict] = None
         state = None
+        verifier = q.attest
         for b in reversed(barriers):
             state = q._fleet_ckpt.load(int(b["generation"]))
-            if state is not None:
-                meta = b
-                break
+            if state is None:
+                continue
+            att_rec = b.get("attest")
+            if att_rec is not None:
+                # the journal pinned a digest of the fleet bits at this
+                # barrier — refuse a snapshot whose BITS drifted even if
+                # its pickle bytes are internally sha256-consistent
+                # (file swapped/rebuilt after the fact), naming the
+                # splitting leaves and falling back one barrier
+                if verifier is None:
+                    from ..core.attest import StateAttestor
+
+                    verifier = StateAttestor()
+                try:
+                    verifier.verify(
+                        state,
+                        att_rec,
+                        generation=int(b["generation"]),
+                        where=f"fleet snapshot {b.get('snapshot')}",
+                    )
+                except IntegrityError as e:
+                    event = {
+                        "event": "corrupt_snapshot",
+                        "generation": int(b["generation"]),
+                        "snapshot": b.get("snapshot"),
+                        "leaves": list(e.leaves),
+                        "action": "barrier_fallback",
+                    }
+                    q.integrity_events.append(event)
+                    journal.append("integrity", **event, error=str(e)[:300])
+                    if q.metrics is not None:
+                        q.metrics.count("integrity.recover_refusals")
+                        q.metrics.event(
+                            "integrity.corrupt_snapshot", **event
+                        )
+                    state = None
+                    continue
+            meta = b
+            break
         if meta is None:
             # start()ed but no barrier landed (killed in the first chunk
             # or mid-first-fsync): re-queue everything and start fresh
@@ -2486,4 +2549,6 @@ class RunQueue:
         }
         if self.journal is not None:
             out["journal"] = self.journal.report()
+        if self.integrity_events:
+            out["integrity_events"] = [dict(e) for e in self.integrity_events]
         return out

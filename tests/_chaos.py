@@ -368,3 +368,139 @@ class NaNEnv:
         if self._steps > self.poison_after:
             r = float("nan")
         return obs, r, term, trunc, info
+
+
+# --------------------------------------------------------------------------
+# silent-data-corruption injection (ISSUE 20, core/attest.py)
+
+
+def flip_bit(state, leaf: str, index: int = 0, bit: int = 0, at_gen=None,
+             kind: str = "mantissa"):
+    """Return ``state`` with exactly ONE bit flipped in the named leaf —
+    the canonical silent-data-corruption analog (a cosmic-ray upset in
+    HBM). On-device and trace-safe: the flip is a bitcast-XOR
+    where-select, so it composes into a jitted/fused step and can be
+    gated on a TRACED generation (``at_gen``; ``None`` flips
+    unconditionally).
+
+    ``leaf`` is a dotted attribute path into the state
+    (``"algo.C"``, ``"tenants.algo.mean"``); ``index`` is the FLAT
+    element index; ``kind`` picks the bit region for float leaves:
+    ``"mantissa"`` flips mantissa bit ``bit`` (a tiny, sub-tolerance
+    perturbation — exactly what allclose-based checks miss and bitwise
+    attestation catches), ``"exponent"`` flips exponent bit ``bit`` (a
+    catastrophic magnitude error). Integer leaves flip bit ``bit``
+    directly."""
+    import jax
+    import jax.numpy as jnp
+
+    parts = leaf.split(".")
+    target = state
+    for p in parts:
+        target = getattr(target, p)
+    x = jnp.asarray(target)
+    if x.dtype == jnp.float32:
+        word = jnp.uint32(1) << jnp.uint32(
+            bit if kind == "mantissa" else 23 + bit
+        )
+        flat = jax.lax.bitcast_convert_type(x, jnp.uint32).reshape(-1)
+        flat = flat.at[index].set(flat[index] ^ word)
+        flipped = jax.lax.bitcast_convert_type(
+            flat.reshape(x.shape), jnp.float32
+        )
+    elif x.dtype in (jnp.int32, jnp.uint32):
+        word = jnp.asarray(1, x.dtype) << jnp.asarray(bit, x.dtype)
+        flat = x.reshape(-1)
+        flat = flat.at[index].set(flat[index] ^ word)
+        flipped = flat.reshape(x.shape)
+    else:
+        raise NotImplementedError(f"flip_bit: unsupported dtype {x.dtype}")
+    if at_gen is not None:
+        due = jnp.asarray(state.generation, jnp.int32) == jnp.asarray(
+            at_gen, jnp.int32
+        )
+        flipped = jnp.where(due, flipped, x)
+    rebuilt = flipped
+    for i in range(len(parts) - 1, -1, -1):
+        holder = state
+        for p in parts[:i]:
+            holder = getattr(holder, p)
+        rebuilt = holder.replace(**{parts[i]: rebuilt})
+    return rebuilt
+
+
+class BitFlipStep:
+    """Workflow shim whose ``run`` flips one bit at generation ``at_gen``
+    then continues honestly — the reproducible ``suspect`` leg for
+    :func:`evox_tpu.core.attest.bisect_divergence` (the fault is a pure
+    function of the traced generation, so it reproduces identically at
+    ANY chunking). Also usable as a full faulty drive in executor tests."""
+
+    def __init__(self, wf, leaf: str, at_gen: int, index: int = 0,
+                 bit: int = 0, kind: str = "mantissa"):
+        self.wf = wf
+        self.leaf = leaf
+        self.at_gen = at_gen
+        self.index = index
+        self.bit = bit
+        self.kind = kind
+
+    def __getattr__(self, name):
+        return getattr(self.wf, name)
+
+    def run(self, state, n_steps: int):
+        # step one generation at a time so the flip gate sees every
+        # intermediate generation; bit-identical to wf.run when the
+        # flip generation is outside [gen, gen+n) (fori chunking law)
+        for _ in range(int(n_steps)):
+            state = self.wf.run(state, 1)
+            state = flip_bit(
+                state, self.leaf, index=self.index, bit=self.bit,
+                at_gen=self.at_gen, kind=self.kind,
+            )
+        return state
+
+
+class LyingPod:
+    """Dispatch shim that returns WRONG-BUT-PLAUSIBLE chunk results on
+    scripted call indices — the silent-data-corruption analog of
+    :class:`FlakyDispatch` (which models loud faults). ``lies`` maps
+    0-based call indices to a flavor: ``"perturb"`` returns the honest
+    result with one mantissa bit flipped in ``leaf`` (sub-tolerance SDC),
+    ``"stale"`` returns the PREVIOUS honest result (a pod that silently
+    dropped its chunk). Unlisted calls pass through. Deterministic, so
+    voting tests assert exact heal/abort outcomes; ``sticky=True`` makes
+    every listed flavor apply to ALL calls from its index on (the
+    reproducible-fault shape bisection needs)."""
+
+    def __init__(self, fn, lies=None, leaf: str = "algo.mean",
+                 bit: int = 0, sticky: bool = False):
+        self.fn = fn
+        self.lies = dict(lies or {})
+        self.leaf = leaf
+        self.bit = bit
+        self.sticky = sticky
+        self.calls = 0
+        self.honest = 0
+        self._last = None
+
+    def _flavor(self, index):
+        if self.sticky:
+            live = [i for i in self.lies if i <= index]
+            return self.lies[max(live)] if live else None
+        return self.lies.get(index)
+
+    def __call__(self, *args, **kwargs):
+        index = self.calls
+        self.calls += 1
+        flavor = self._flavor(index)
+        result = self.fn(*args, **kwargs)
+        if flavor is None:
+            self.honest += 1
+            self._last = result
+            return result
+        if flavor == "stale":
+            return self._last if self._last is not None else result
+        if flavor == "perturb":
+            return flip_bit(result, self.leaf, index=0, bit=self.bit)
+        raise ValueError(f"unknown lie flavor: {flavor!r}")

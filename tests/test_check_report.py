@@ -694,8 +694,8 @@ def test_validator_v11_schema_version_rules():
     """v11 reports must carry a schema_version int that agrees with the
     schema tag suffix; v10-and-earlier reports stay exempt."""
     report = _fresh_report(False)
-    assert report["schema"] == "evox_tpu.run_report/v13"
-    assert report["schema_version"] == 13
+    assert report["schema"] == "evox_tpu.run_report/v14"
+    assert report["schema_version"] == 14
     bad = json.loads(json.dumps(report))
     del bad["schema_version"]
     errors = "\n".join(check_report.validate_run_report(bad))
@@ -854,7 +854,7 @@ def test_validate_file_sniffs_metrics_stream(tmp_path):
 def test_schema_flag_lists_and_detects(tmp_path, capsys):
     assert check_report.main(["--schema"]) == 0
     out = capsys.readouterr().out
-    assert "evox_tpu.run_report/v13" in out
+    assert "evox_tpu.run_report/v14" in out
     assert "evox_tpu.metrics_stream/v1" in out
     from evox_tpu import FlightRecorder
 

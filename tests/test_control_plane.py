@@ -185,7 +185,7 @@ def test_placement_spreads_and_tags_are_unique(tmp_path):
     assert rep["events"]["submit"] == 4 and rep["events"]["place"] == 4
     # the section rides run_report as schema v13 and validates green
     full = run_report(control_plane=plane)
-    assert full["schema_version"] == 13
+    assert full["schema_version"] == 14
     assert full["control_plane"]["tenants"]["results"] == 4
     assert _check_report().validate_run_report(full) == []
     # a fresh gateway over a used directory must refuse (fork protection)

@@ -530,8 +530,8 @@ def test_executor_section_and_trace_validate(tmp_path):
     s = wf.init(jax.random.PRNGKey(4))
     s = ex.run_host(wf, s, 6)
     rep = run_report(wf, s, recorder=rec)
-    assert rep["schema"].endswith("/v13")
-    assert rep["schema_version"] == 13
+    assert rep["schema"].endswith("/v14")
+    assert rep["schema_version"] == 14
     assert rep["executor"]["counters"]["tells"] == 6
     assert rep["executor"]["overlap"]["wall_s"] > 0
     assert check_report.validate_run_report(rep) == []

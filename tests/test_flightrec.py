@@ -455,3 +455,97 @@ def test_record_search_disabled_is_noop():
         "no search.* gauges — attach a LineageMonitor and "
         "publish via FlightRecorder.record_search"
     ]
+
+
+# ---------------------------------------------- integrity view (ISSUE 20)
+
+_INTEGRITY_SECTION = {
+    "enabled": True,
+    "every": 5,
+    "attestations": 4,
+    "ring": [
+        {"generation": 15, "digest": "ab" * 24},
+        {"generation": 20, "digest": "cd" * 24},
+    ],
+    "verify": {
+        "verify_every": 2,
+        "redispatches": 4,
+        "verified_chunks": 2,
+        "mismatches": 1,
+        "healed": 1,
+        "aborted": 0,
+    },
+    "bisection": {
+        "first_divergent_generation": 13,
+        "window": [11, 15],
+        "leaves": [".algo.C"],
+    },
+    "verdict": "healed",
+}
+
+
+def test_record_integrity_publishes_gauges_and_evoxtail_renders(tmp_path):
+    """record_integrity maps a run_report integrity section onto the
+    integrity.* gauge namespace; evoxtail --integrity renders exactly
+    this card (byte-pinned: the view is a scrape-side contract, like
+    the search card above)."""
+    fr = FlightRecorder(directory=str(tmp_path))
+    fr.record_integrity(_INTEGRITY_SECTION)
+    fr.sample(generation=20)
+    ig = {
+        k: v
+        for k, v in fr.registry.snapshot()["gauges"].items()
+        if k.startswith("integrity.")
+    }
+    assert ig["integrity.attestations"] == 4
+    assert ig["integrity.last_generation"] == 20  # newest ring entry
+    assert ig["integrity.redispatches"] == 4
+    assert ig["integrity.mismatches"] == 1
+    assert ig["integrity.healed"] == 1
+    assert ig["integrity.first_divergent_generation"] == 13
+
+    records = read_stream(str(tmp_path / "metrics.jsonl"))
+    # the non-clean verdict rides the anomaly lane as an event record
+    assert any(
+        r.get("kind") == "event"
+        and r.get("name") == "integrity.verdict"
+        and r.get("verdict") == "healed"
+        for r in records
+    )
+    assert evoxtail.render_integrity(records) == [
+        "compute integrity (newest sample)",
+        "  attestations  4   last attested generation 20",
+        "  verify rung   2 verified / 1 mismatched  (4 re-dispatches)",
+        "  healed        1   aborted 0",
+        "  bisection     first divergent generation 13",
+        "  verdict       healed",
+    ]
+
+
+def test_record_integrity_disabled_is_noop():
+    fr = FlightRecorder()
+    fr.record_integrity({"enabled": False})
+    fr.record_integrity({"error": "attestor blew up"})
+    fr.record_integrity(None)
+    assert not any(
+        k.startswith("integrity.")
+        for k in fr.registry.snapshot()["gauges"]
+    )
+    # a clean attested run publishes gauges but NO verdict event
+    fr2 = FlightRecorder()
+    fr2.record_integrity(
+        {
+            "enabled": True,
+            "attestations": 2,
+            "ring": [{"generation": 10, "digest": "ab" * 24}],
+            "verdict": "clean",
+        }
+    )
+    assert fr2.registry.snapshot()["gauges"]["integrity.attestations"] == 2
+    assert not any(
+        r.get("name") == "integrity.verdict" for r in fr2._ring
+    )
+    assert evoxtail.render_integrity([{"kind": "sample", "gauges": {}}]) == [
+        "no integrity.* gauges — attach a StateAttestor and "
+        "publish via FlightRecorder.record_integrity"
+    ]

@@ -500,8 +500,8 @@ def test_run_report_sharding_section():
     s = wf.run(s, 12)
     rec.fetch(s.algo.sigma, name="sigma")
     report = run_report(wf, s, recorder=rec)
-    assert report["schema"] == "evox_tpu.run_report/v13"
-    assert report["schema_version"] == 13
+    assert report["schema"] == "evox_tpu.run_report/v14"
+    assert report["schema_version"] == 14
     shd = report["roofline"]["sharding"]
     assert shd["axis"] == POP_AXIS and shd["n_devices"] == N_DEV
     assert shd["gather_free"] is True

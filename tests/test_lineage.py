@@ -327,7 +327,7 @@ def test_best_ancestry_acceptance_and_report_v13():
             assert all(b <= a + 1e-6 for a, b in zip(bf, bf[1:]))
         assert bf[-1] <= bf[0]
         rep = run_report(workflow=wf, state=state)
-        assert rep["schema_version"] == 13
+        assert rep["schema_version"] == 14
         assert rep["search"]["enabled"] is True
         errors = check_report.validate_run_report(rep)
         assert not errors, errors

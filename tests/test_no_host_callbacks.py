@@ -328,6 +328,21 @@ def test_surrogate_modules_are_callback_free():
         assert rel not in users, f"{rel} must not use host callbacks"
 
 
+def test_attest_module_is_callback_free():
+    """The ISSUE-20 compute-integrity layer must hold the axon constraint
+    by construction: the attestation digest runs INSIDE the fused
+    fori_loop (a lax.cond around pure uint32 mixing), the voted
+    re-dispatch rung compares tiny fetched digest words between
+    dispatches, and bisection replays chunks eagerly from the host — a
+    host callback anywhere in core/attest.py would make state
+    attestation unusable on the exact backend whose silent-data-
+    corruption modes it exists to catch."""
+    users = _scan()
+    rel = "core/attest.py"
+    assert (PKG / rel).exists(), f"{rel} missing"
+    assert rel not in users, f"{rel} must not use host callbacks"
+
+
 def test_pod_supervisor_module_is_callback_free():
     """The ISSUE-14 pod fault domain must hold the axon constraint by
     construction: heartbeats, censuses, watchdog deadlines, drain

@@ -377,8 +377,8 @@ def test_pod_shrink_resume_8_to_4_analog(tmp_path):
 
         # v9 report + trace schema, incl. reform↔resume coherence
         rep = run_report(wf1, final)
-        assert rep["schema"] == "evox_tpu.run_report/v13"
-        assert rep["schema_version"] == 13
+        assert rep["schema"] == "evox_tpu.run_report/v14"
+        assert rep["schema_version"] == 14
         pod = rep["pod_supervisor"]
         assert pod["outcome"] == "resumed"
         kinds = [e["event"] for e in pod["events"]]

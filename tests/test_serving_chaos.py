@@ -608,7 +608,7 @@ def test_metrics_sweep_slo_ledger_and_exact_noop(tmp_path, reference):
     assert samples[-1]["queue"]["retired"] == q.counters["retired"]
     # run_report picks the recorder up through the workflow backref
     rep = run_report(q.workflow, q.state)
-    assert rep["schema_version"] == 13
+    assert rep["schema_version"] == 14
     assert rep["metrics"]["counters"]["slo.tenant_gens"] == total_gens
     assert rep["metrics"]["stream"]["records"] == len(q.metrics.stream.records())
     assert rep["slo"]["admissions"] == len(pc.BUDGETS)

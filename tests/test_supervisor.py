@@ -437,6 +437,13 @@ def test_checkpoint_resumes_across_8_4_1_device_meshes(tmp_path):
             np.asarray(resumed.monitors[0].ring_best),
             np.asarray(straight.monitors[0].ring_best),
         )
+        # the integer counter surface IS genuinely bitwise across
+        # layouts — hold it to the stable attestor fingerprint instead
+        # of letting the allclose below paper over it (ISSUE 20)
+        tm = TelemetryMonitor(capacity=32)
+        assert tm.fingerprint(
+            resumed.monitors[0], stable=True
+        ) == tm.fingerprint(straight.monitors[0], stable=True)
         _tree_assert_allclose(resumed, straight)
 
 

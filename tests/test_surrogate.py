@@ -645,8 +645,8 @@ def test_run_report_surrogate_section_and_validator():
     state = wf.init(jax.random.PRNGKey(7))
     state = ex.run_host(wf, state, 6)
     report = run_report(wf, state, recorder=rec, executor=ex)
-    assert report["schema"] == "evox_tpu.run_report/v13"
-    assert report["schema_version"] == 13
+    assert report["schema"] == "evox_tpu.run_report/v14"
+    assert report["schema_version"] == 14
     sur = report["surrogate"]
     assert sur["enabled"] is True and sur["model"] == "ensemble"
     c = sur["counters"]

@@ -675,8 +675,8 @@ def test_run_report_tenancy_section_valid():
                             hyperparams={"init_stdev": 1.0}))
     q.run()
     report = run_report(wf, q.state)
-    assert report["schema"] == "evox_tpu.run_report/v13"
-    assert report["schema_version"] == 13
+    assert report["schema"] == "evox_tpu.run_report/v14"
+    assert report["schema_version"] == 14
     ten = report["tenancy"]
     assert ten["n_tenants"] == 2
     assert ten["leading_axes"] == [2]

@@ -45,7 +45,17 @@ SLO_KEYS = (
 )
 CLASSIFICATIONS = {"compute-bound", "memory-bound", "dispatch-bound", None}
 SUPERVISOR_OUTCOMES = {"clean", "recovered", "aborted"}
-SUPERVISOR_EVENTS = {"retry", "deadline", "restore", "degrade", "abort"}
+# v14 (ISSUE 20, core/attest.py): integrity_mismatch/integrity_heal are
+# the voted re-dispatch rung's supervisor events
+SUPERVISOR_EVENTS = {
+    "retry",
+    "deadline",
+    "restore",
+    "degrade",
+    "abort",
+    "integrity_mismatch",
+    "integrity_heal",
+}
 SUPERVISOR_COUNTERS = (
     "dispatches",
     "retries",
@@ -66,7 +76,15 @@ POD_EVENTS = {
     "reform",
     "resume",
 }
-POD_FAILURE_CLASSES = {"worker_dead", "hung_collective", "coordinator_loss"}
+POD_FAILURE_CLASSES = {
+    "worker_dead",
+    "hung_collective",
+    "coordinator_loss",
+    # v14 (ISSUE 20): a pod outvoted in a 2-of-3 integrity vote
+    "integrity_dissent",
+}
+# v14 (ISSUE 20, core/attest.py): the integrity section's verdict set
+INTEGRITY_VERDICTS = {"clean", "detected", "healed", "aborted"}
 POD_COUNTERS = (
     "heartbeats",
     "censuses",
@@ -220,6 +238,9 @@ def validate_run_report(report: Any, where: str = "run_report") -> List[str]:
     search = report.get("search")
     if search is not None:
         errors += _validate_search(search, where)
+    integrity = report.get("integrity")
+    if integrity is not None:
+        errors += _validate_integrity(integrity, where)
     control_plane = report.get("control_plane")
     if control_plane is not None:
         errors += _validate_control_plane(control_plane, where)
@@ -891,6 +912,177 @@ SEARCH_OP_NAMES = {
     "crossover",
     "mutation",
 }
+
+
+def _validate_integrity(integrity: Any, where: str) -> List[str]:
+    """The ``integrity`` section (schema v14, ISSUE 20, core/attest.py):
+    the attestation ring's generations must be strictly increasing and
+    cadence-aligned (every entry divisible by ``every``) with 48-char
+    hex digests; the verdict must come from the closed set; the verify
+    counters must cohere (``verify_dispatches == verified_chunks +
+    2*mismatches`` — each mismatch costs exactly two extra dispatches —
+    and ``healed <= mismatches``); a bisection that names a first
+    divergent generation must name one inside its replay window."""
+    errors: List[str] = []
+    if not isinstance(integrity, dict):
+        return [f"{where}: integrity is not an object"]
+    if set(integrity) == {"error"}:
+        # degraded form, same contract as roofline.error / search.error
+        if not isinstance(integrity["error"], str):
+            errors.append(f"{where}: integrity.error is not a string")
+        return errors
+    enabled = integrity.get("enabled")
+    if not isinstance(enabled, bool):
+        errors.append(f"{where}: integrity.enabled missing or not a bool")
+    if not enabled:
+        return errors  # disabled sections are minimal by design
+    verdict = integrity.get("verdict")
+    if verdict not in INTEGRITY_VERDICTS:
+        errors.append(
+            f"{where}: integrity.verdict {verdict!r} not in "
+            f"{sorted(INTEGRITY_VERDICTS)}"
+        )
+    attestations = integrity.get("attestations")
+    if not isinstance(attestations, int) or attestations < 0:
+        errors.append(
+            f"{where}: integrity.attestations missing or not a "
+            "non-negative int"
+        )
+    every = integrity.get("every")
+    if every is not None and (not isinstance(every, int) or every < 1):
+        errors.append(f"{where}: integrity.every is not a positive int")
+    ring = integrity.get("ring")
+    if not isinstance(ring, list):
+        errors.append(f"{where}: integrity.ring missing")
+        ring = []
+    last_gen = None
+    for i, entry in enumerate(ring):
+        loc = f"{where}: integrity.ring[{i}]"
+        if not isinstance(entry, dict):
+            errors.append(f"{loc} is not an object")
+            continue
+        gen = entry.get("generation")
+        if not isinstance(gen, int) or gen < 0:
+            errors.append(f"{loc}.generation missing or negative")
+            continue
+        if last_gen is not None and gen <= last_gen:
+            errors.append(
+                f"{loc}.generation {gen} not strictly increasing "
+                f"(previous {last_gen}) — the ring is chronological"
+            )
+        last_gen = gen
+        if isinstance(every, int) and every >= 1 and gen % every != 0:
+            errors.append(
+                f"{loc}.generation {gen} is not a multiple of the "
+                f"attestation cadence {every}"
+            )
+        digest = entry.get("digest")
+        if (
+            not isinstance(digest, str)
+            or len(digest) != 48
+            or any(c not in "0123456789abcdef" for c in digest)
+        ):
+            errors.append(f"{loc}.digest is not a 48-char lowercase hex")
+    verify = integrity.get("verify")
+    if verify is not None:
+        if not isinstance(verify, dict):
+            errors.append(f"{where}: integrity.verify is not an object")
+        else:
+            for key in (
+                "redispatches",
+                "verified_chunks",
+                "mismatches",
+                "healed",
+                "aborted",
+            ):
+                v = verify.get(key)
+                if not isinstance(v, int) or v < 0:
+                    errors.append(
+                        f"{where}: integrity.verify.{key} missing or not "
+                        "a non-negative int"
+                    )
+            rd, vc, mm = (
+                verify.get("redispatches"),
+                verify.get("verified_chunks"),
+                verify.get("mismatches"),
+            )
+            if (
+                isinstance(rd, int)
+                and isinstance(vc, int)
+                and isinstance(mm, int)
+                and rd != vc + 2 * mm
+            ):
+                errors.append(
+                    f"{where}: integrity.verify.redispatches {rd} != "
+                    f"verified_chunks {vc} + 2*mismatches {mm} — each "
+                    "mismatch escalates to exactly two more dispatches"
+                )
+            healed = verify.get("healed")
+            if (
+                isinstance(healed, int)
+                and isinstance(mm, int)
+                and healed > mm
+            ):
+                errors.append(
+                    f"{where}: integrity.verify.healed {healed} > "
+                    f"mismatches {mm} — a heal needs a detected mismatch"
+                )
+            ve = verify.get("verify_every")
+            if ve is not None and (not isinstance(ve, int) or ve < 1):
+                errors.append(
+                    f"{where}: integrity.verify.verify_every is not a "
+                    "positive int"
+                )
+    bisection = integrity.get("bisection")
+    if bisection is not None:
+        if not isinstance(bisection, dict):
+            errors.append(f"{where}: integrity.bisection is not an object")
+        else:
+            fdg = bisection.get("first_divergent_generation")
+            window = bisection.get("window")
+            if fdg is not None:
+                if not isinstance(fdg, int):
+                    errors.append(
+                        f"{where}: integrity.bisection."
+                        "first_divergent_generation is not an int"
+                    )
+                elif (
+                    isinstance(window, (list, tuple))
+                    and len(window) == 2
+                    and all(isinstance(w, int) for w in window)
+                    and not (window[0] < fdg <= window[1])
+                ):
+                    errors.append(
+                        f"{where}: integrity.bisection names generation "
+                        f"{fdg} outside its replay window {list(window)}"
+                    )
+    # verdict ↔ counter coherence: a verdict that claims healing/abort
+    # must be backed by the matching counter, and vice versa
+    if isinstance(verify, dict):
+        healed, aborted, mm = (
+            verify.get("healed"),
+            verify.get("aborted"),
+            verify.get("mismatches"),
+        )
+        if verdict == "healed" and not healed:
+            errors.append(
+                f"{where}: integrity.verdict 'healed' with verify.healed 0"
+            )
+        if verdict == "aborted" and not aborted:
+            errors.append(
+                f"{where}: integrity.verdict 'aborted' with "
+                "verify.aborted 0"
+            )
+        if (
+            verdict == "clean"
+            and isinstance(mm, int)
+            and mm > 0
+        ):
+            errors.append(
+                f"{where}: integrity.verdict 'clean' with "
+                f"verify.mismatches {mm}"
+            )
+    return errors
 
 
 def _validate_search(search: Any, where: str) -> List[str]:
@@ -2447,7 +2639,7 @@ def validate_file(path: str) -> List[str]:
 #: ``--schema`` prints so drivers/tests can pin the supported range
 #: without parsing the module
 SUPPORTED_SCHEMAS = (
-    "evox_tpu.run_report/v13 (validates v1-v13)",
+    "evox_tpu.run_report/v14 (validates v1-v14)",
     "evox_tpu.metrics_stream/v1",
     "evox_tpu.bench_trajectory/v1",
     "bench summary (sub_metrics)",

@@ -229,6 +229,53 @@ def render_search(records: List[dict]) -> List[str]:
     return lines
 
 
+def render_integrity(records: List[dict]) -> List[str]:
+    """The compute-integrity view (--integrity): the ``integrity.*``
+    gauge namespace a FlightRecorder.record_integrity publish left in
+    the newest sample (core/attest.py StateAttestor + the executor's
+    voted re-dispatch counters), rendered as the bit-trust card —
+    attestation ring progress, the verify rung's tally, any named
+    first divergent generation, and the newest non-clean verdict."""
+    sample = newest(records, "sample")
+    gauges = (sample or {}).get("gauges") or {}
+    integ = {
+        k[len("integrity."):]: v
+        for k, v in gauges.items()
+        if k.startswith("integrity.")
+    }
+    if not integ:
+        return ["no integrity.* gauges — attach a StateAttestor and "
+                "publish via FlightRecorder.record_integrity"]
+    lines = ["compute integrity (newest sample)"]
+    lines.append(
+        f"  attestations  {_fmt_num(integ.get('attestations', 0))}"
+        f"   last attested generation"
+        f" {_fmt_num(integ.get('last_generation', 0))}"
+    )
+    if "redispatches" in integ:
+        lines.append(
+            f"  verify rung   {_fmt_num(integ.get('verified_chunks', 0))}"
+            f" verified / {_fmt_num(integ.get('mismatches', 0))} mismatched"
+            f"  ({_fmt_num(integ.get('redispatches', 0))} re-dispatches)"
+        )
+        lines.append(
+            f"  healed        {_fmt_num(integ.get('healed', 0))}"
+            f"   aborted {_fmt_num(integ.get('aborted', 0))}"
+        )
+    if "first_divergent_generation" in integ:
+        lines.append(
+            "  bisection     first divergent generation "
+            f"{_fmt_num(integ['first_divergent_generation'])}"
+        )
+    verdict = None
+    for rec in reversed(records):
+        if rec.get("kind") == "event" and rec.get("name") == "integrity.verdict":
+            verdict = rec.get("verdict")
+            break
+    lines.append(f"  verdict       {verdict or 'clean'}")
+    return lines
+
+
 def render_summary(records: List[dict], path: str) -> List[str]:
     lines = [f"stream: {path}"]
     meta = newest(records, "meta")
@@ -422,6 +469,12 @@ def main(argv: List[str]) -> int:
         "gauges of the newest sample",
     )
     ap.add_argument(
+        "--integrity",
+        action="store_true",
+        help="compute-integrity view: the integrity.* attestation/verify "
+        "gauges of the newest sample",
+    )
+    ap.add_argument(
         "--interval",
         type=float,
         default=0.5,
@@ -459,6 +512,9 @@ def main(argv: List[str]) -> int:
         return 0
     if args.search:
         print("\n".join(render_search(records)))
+        return 0
+    if args.integrity:
+        print("\n".join(render_integrity(records)))
         return 0
     if args.replay:
         for rec in records:
